@@ -1,0 +1,33 @@
+"""diamond_types_tpu — a TPU-native rebuild of the diamond-types text CRDT.
+
+A ground-up redesign of the capabilities of the reference Rust implementation
+(jarrodhroberson/diamond-types): an append-only operation log over a causal
+DAG ("time DAG"), branches as (version, content) checkpoints, and a merge
+engine that transforms concurrent positional edits into a linear, replayable
+stream.
+
+Architecture (TPU-first, see SURVEY.md §7):
+  - Host tier: columnar causal graph + op storage (numpy-backed), binary
+    wire format, sync protocol. A C++ native core mirrors the hot host paths.
+  - Device tier (JAX/XLA): batched merge kernels — conflict zones lowered to
+    dense span tables, vmapped across documents, sharded over a device Mesh.
+
+Public API mirrors the reference's stable list API (reference:
+src/list/mod.rs:66-145): `OpLog`, `Branch`, `ListCRDT`.
+"""
+
+from .causalgraph.graph import Graph, ROOT, DiffFlag
+from .causalgraph.agent import AgentAssignment
+from .causalgraph.causal_graph import CausalGraph
+from .core.frontier import frontier_from, frontier_eq
+from .text.oplog import OpLog
+from .text.branch import Branch
+from .text.crdt import ListCRDT
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Graph", "ROOT", "DiffFlag", "AgentAssignment", "CausalGraph",
+    "OpLog", "Branch", "ListCRDT",
+    "frontier_from", "frontier_eq",
+]

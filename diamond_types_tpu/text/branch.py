@@ -1,0 +1,71 @@
+"""A branch: (version frontier, document content) — a live checkpoint.
+
+Capability mirror of the reference ListBranch (reference: src/list/mod.rs:66-76,
+src/list/branch.rs, src/list/merge.rs:63-96).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..utils.rope import Rope
+from .op import DEL, INS
+from .oplog import OpLog
+
+
+class Branch:
+    __slots__ = ("version", "content")
+
+    def __init__(self) -> None:
+        self.version: List[int] = []
+        self.content = Rope()
+
+    def __len__(self) -> int:
+        return len(self.content)
+
+    def snapshot(self) -> str:
+        return str(self.content)
+
+    # --- local edits (append to oplog, then apply here) --------------------
+
+    def insert(self, oplog: OpLog, agent: int, pos: int, content: str) -> int:
+        lv = oplog.add_insert_at(agent, self.version, pos, content)
+        self.content.insert(pos, content)
+        self.version = [lv]
+        return lv
+
+    def delete(self, oplog: OpLog, agent: int, start: int, end: int) -> int:
+        deleted = self.content.slice(start, end)
+        lv = oplog.add_delete_at(agent, self.version, start, end, deleted)
+        self.content.delete(start, end - start)
+        self.version = [lv]
+        return lv
+
+    def delete_without_content(self, oplog: OpLog, agent: int, start: int,
+                               end: int) -> int:
+        lv = oplog.add_delete_at(agent, self.version, start, end, None)
+        self.content.delete(start, end - start)
+        self.version = [lv]
+        return lv
+
+    # --- merge -------------------------------------------------------------
+
+    def merge(self, oplog: OpLog, merge_frontier: Sequence[int]) -> None:
+        """Bring everything in `merge_frontier`'s history into this branch
+        (reference: src/list/merge.rs:63-96)."""
+        xf = oplog.get_xf_operations_full(self.version, merge_frontier)
+        for _lv, op, pos in xf:
+            if pos is None:
+                continue  # delete already happened
+            if op.kind == INS:
+                content = oplog.ops.get_run_content(op)
+                assert content is not None
+                if not op.fwd:
+                    content = content[::-1]
+                self.content.insert(pos, content)
+            else:
+                self.content.delete(pos, len(op))
+        self.version = list(xf.next_frontier)
+
+    def merge_tip(self, oplog: OpLog) -> None:
+        self.merge(oplog, oplog.version)

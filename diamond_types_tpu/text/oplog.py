@@ -1,0 +1,123 @@
+"""The document operation log.
+
+Capability mirror of the reference ListOpLog (reference: src/list/mod.rs:104-126,
+src/list/oplog.rs): an append-only columnar op table + causal graph + content
+arenas. Every public entry point of the reference's stable list API is here:
+local/remote append paths, checkout, transformed-op iteration, stats.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from ..causalgraph.causal_graph import CausalGraph
+from ..core.span import Span
+from ..listmerge.transform import TransformedOps
+from .op import DEL, INS, OpRun, OpStore
+
+
+class OpLog:
+    __slots__ = ("cg", "ops", "doc_id")
+
+    def __init__(self) -> None:
+        self.cg = CausalGraph()
+        self.ops = OpStore()
+        self.doc_id: Optional[str] = None
+
+    def __len__(self) -> int:
+        return len(self.cg)
+
+    def get_or_create_agent_id(self, name: str) -> int:
+        return self.cg.get_or_create_agent(name)
+
+    @property
+    def version(self) -> List[int]:
+        return list(self.cg.version)
+
+    # --- local append path (reference: src/list/oplog.rs:203-296) ---------
+
+    def add_insert_at(self, agent: int, parents: Sequence[int], pos: int,
+                      content: str) -> int:
+        """Append an insert op; returns the last new LV."""
+        lv = len(self)
+        self.ops.push_op(lv, INS, pos, pos + len(content), True, content)
+        self.cg.assign_local_op_with_parents(parents, agent, len(content))
+        return lv + len(content) - 1
+
+    def add_delete_at(self, agent: int, parents: Sequence[int], start: int,
+                      end: int, content: Optional[str] = None) -> int:
+        lv = len(self)
+        n = end - start
+        assert n > 0
+        self.ops.push_op(lv, DEL, start, end, True, content)
+        self.cg.assign_local_op_with_parents(parents, agent, n)
+        return lv + n - 1
+
+    def add_insert(self, agent: int, pos: int, content: str) -> int:
+        return self.add_insert_at(agent, self.version, pos, content)
+
+    def add_delete_without_content(self, agent: int, start: int, end: int) -> int:
+        return self.add_delete_at(agent, self.version, start, end)
+
+    # --- remote append path ------------------------------------------------
+
+    def add_remote_op(self, agent: int, seq_start: int, parents: Sequence[int],
+                      kind: int, start: int, end: int, fwd: bool,
+                      content: Optional[str]) -> Span:
+        """Merge a remote op run; dedups already-known spans via the causal
+        graph (reference: decode path, causalgraph.rs:132)."""
+        n = end - start
+        span = self.cg.merge_and_assign(parents, agent, seq_start, n)
+        new_len = span[1] - span[0]
+        if new_len > 0:
+            skip = n - new_len
+            if skip and content is not None:
+                content = content[skip:]
+            if skip:
+                from .op import sub_op_loc
+                start, end = sub_op_loc(kind, start, end, fwd, skip, n)
+            self.ops.push_op(span[0], kind, start, end, fwd, content)
+        return span
+
+    # --- transformed ops ---------------------------------------------------
+
+    def get_xf_operations_full(self, from_frontier: Sequence[int],
+                               merge_frontier: Sequence[int]) -> TransformedOps:
+        return TransformedOps(self.cg.graph, self.cg.agent_assignment, self.ops,
+                              list(from_frontier), list(merge_frontier))
+
+    def iter_xf_operations_from(self, from_frontier: Sequence[int],
+                                merge_frontier: Sequence[int]
+                                ) -> Iterator[Tuple[Span, Optional[OpRun], Optional[str]]]:
+        """Yield (lv_span, transformed_op | None, content | None)."""
+        xf = self.get_xf_operations_full(from_frontier, merge_frontier)
+        for lv, op, pos in xf:
+            n = len(op)
+            if pos is None:
+                yield ((lv, lv + n), None, None)
+            else:
+                moved = OpRun(op.lv, op.kind, pos, pos + n, op.fwd, op.content_pos)
+                yield ((lv, lv + n), moved, self.ops.get_run_content(op))
+
+    def iter_xf_operations(self):
+        return self.iter_xf_operations_from([], self.version)
+
+    # --- checkout ----------------------------------------------------------
+
+    def checkout(self, frontier: Sequence[int]):
+        from .branch import Branch
+        b = Branch()
+        b.merge(self, frontier)
+        return b
+
+    def checkout_tip(self):
+        return self.checkout(self.version)
+
+    # --- misc ---------------------------------------------------------------
+
+    def print_stats(self) -> None:
+        print(f"oplog: {len(self)} LVs in {len(self.ops.runs)} op runs, "
+              f"{len(self.cg.graph)} graph runs, "
+              f"{len(self.cg.agent_assignment.agent_names)} agents, "
+              f"ins arena {self.ops.arena_len(INS)} chars, "
+              f"del arena {self.ops.arena_len(DEL)} chars")

@@ -1,0 +1,18 @@
+import os
+import sys
+
+# Force a virtual 8-device CPU mesh for sharding tests; benches run separately
+# on real TPU hardware (see bench.py which clears these).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_DIR = "/root/reference"
+
+
+def reference_path(*parts):
+    return os.path.join(REFERENCE_DIR, *parts)

@@ -1,0 +1,131 @@
+"""Merge engine unit tests: hand-built concurrent scenarios.
+
+Mirrors the style of the reference's inline tests (reference:
+src/listmerge/merge.rs tests, src/listmerge/simple_oplog.rs).
+"""
+
+from diamond_types_tpu import ListCRDT, OpLog
+from diamond_types_tpu.text.crdt import merge_oplogs
+
+
+def make_simple(agent_name="a"):
+    doc = ListCRDT()
+    doc.get_or_create_agent_id(agent_name)
+    return doc
+
+
+def test_linear_insert_delete():
+    doc = make_simple()
+    doc.insert(0, 0, "hello world")
+    doc.delete(0, 5, 11)
+    doc.insert(0, 5, "!")
+    assert doc.snapshot() == "hello!"
+
+    # Replay from scratch via checkout.
+    b = doc.oplog.checkout_tip()
+    assert b.snapshot() == "hello!"
+
+
+def test_concurrent_inserts_two_agents():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    ol.add_insert_at(a, [], 0, "aaa")
+    # bob inserts concurrently at the same place
+    ol.add_insert_at(b, [], 0, "bbb")
+    br = ol.checkout_tip()
+    # Deterministic agent-name ordering: alice's run sorts before bob's.
+    assert br.snapshot() == "aaabbb"
+
+
+def test_concurrent_inserts_interleave_stability():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    ol.add_insert_at(a, [], 0, "Hi ")
+    v1 = ol.version
+    ol.add_insert_at(a, v1, 3, "alice")
+    ol.add_insert_at(b, v1, 3, "bob")
+    s = ol.checkout_tip().snapshot()
+    assert s == "Hi alicebob"
+
+
+def test_concurrent_delete_same_region():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    ol.add_insert_at(a, [], 0, "abcdef")
+    v = ol.version
+    ol.add_delete_at(a, v, 1, 4)       # -> aef
+    ol.add_delete_at(b, v, 2, 5)       # -> abf
+    s = ol.checkout_tip().snapshot()
+    assert s == "af"
+
+
+def test_insert_inside_concurrently_deleted():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    ol.add_insert_at(a, [], 0, "abcd")
+    v = ol.version
+    ol.add_delete_at(a, v, 0, 4)        # alice deletes everything
+    ol.add_insert_at(b, v, 2, "XY")     # bob inserts in the middle
+    s = ol.checkout_tip().snapshot()
+    assert s == "XY"
+
+
+def test_backspace_run():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("a")
+    ol.add_insert_at(a, [], 0, "abc")
+    # Backspace 3 times from the end: deletes 2, then 1, then 0.
+    v = ol.version
+    v = [ol.add_delete_at(a, v, 2, 3)]
+    v = [ol.add_delete_at(a, v, 1, 2)]
+    v = [ol.add_delete_at(a, v, 0, 1)]
+    assert ol.checkout_tip().snapshot() == ""
+    # The three deletes should have merged into one reverse run.
+    del_runs = [r for r in ol.ops.runs if r.kind == 1]
+    assert len(del_runs) == 1 and not del_runs[0].fwd
+
+
+def test_merge_branch_incremental():
+    doc = make_simple()
+    doc.insert(0, 0, "hello")
+    b = doc.oplog.checkout_tip()
+    doc.insert(0, 5, " world")
+    assert b.snapshot() == "hello"
+    b.merge(doc.oplog, doc.oplog.version)
+    assert b.snapshot() == "hello world"
+
+
+def test_merge_oplogs_convergence():
+    d1 = make_simple("alice")
+    d2 = ListCRDT()
+    d2.get_or_create_agent_id("bob")
+
+    d1.insert(0, 0, "base ")
+    merge_oplogs(d2.oplog, d1.oplog)
+    d2.branch.merge_tip(d2.oplog)
+    assert d2.snapshot() == "base "
+
+    d1.insert(0, 5, "from-alice")
+    d2.insert(0, 5, "from-bob")
+
+    merge_oplogs(d1.oplog, d2.oplog)
+    merge_oplogs(d2.oplog, d1.oplog)
+    s1 = d1.oplog.checkout_tip().snapshot()
+    s2 = d2.oplog.checkout_tip().snapshot()
+    assert s1 == s2
+    assert "from-alice" in s1 and "from-bob" in s1
+
+
+def test_double_delete_merge():
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    ol.add_insert_at(a, [], 0, "xyz")
+    v = ol.version
+    ol.add_delete_at(a, v, 1, 2)
+    ol.add_delete_at(b, v, 1, 2)
+    assert ol.checkout_tip().snapshot() == "xz"
