@@ -1,0 +1,19 @@
+"""CRC-32C (Castagnoli, reflected poly 0x82F63B78) — the file checksum used
+by the wire format (reference: src/encoding/tools.rs:111-115, CRC_32_ISCSI).
+"""
+
+from __future__ import annotations
+
+_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF

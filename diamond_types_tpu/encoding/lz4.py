@@ -1,0 +1,52 @@
+"""Raw LZ4 block decompression (no frame header).
+
+The reference compresses content/patch fields with lz4_flex's block format
+(reference: src/list/encoding/decode_oplog.rs:621-633). This is a standard
+LZ4 block stream: token byte (hi nibble = literal length, lo nibble = match
+length - 4), optional 255-extension bytes, literals, little-endian 16-bit
+match offset, overlapping match copy.
+"""
+
+from __future__ import annotations
+
+
+def lz4_decompress_block(src: bytes, uncompressed_len: int) -> bytes:
+    out = bytearray()
+    i = 0
+    n = len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                b = src[i]
+                i += 1
+                lit_len += b
+                if b != 255:
+                    break
+        if lit_len:
+            out += src[i:i + lit_len]
+            i += lit_len
+        if i >= n:
+            break  # last sequence has literals only
+        offset = src[i] | (src[i + 1] << 8)
+        i += 2
+        if offset == 0:
+            raise ValueError("invalid LZ4 offset 0")
+        match_len = (token & 0xF) + 4
+        if (token & 0xF) == 15:
+            while True:
+                b = src[i]
+                i += 1
+                match_len += b
+                if b != 255:
+                    break
+        start = len(out) - offset
+        if start < 0:
+            raise ValueError("LZ4 offset out of range")
+        for k in range(match_len):  # overlapping copies must go byte-by-byte
+            out.append(out[start + k])
+    if len(out) != uncompressed_len:
+        raise ValueError(f"LZ4 length mismatch: {len(out)} != {uncompressed_len}")
+    return bytes(out)

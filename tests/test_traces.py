@@ -1,0 +1,38 @@
+"""Real-world editing-trace replay (reference: crates/bench/src/main.rs:17-72;
+SURVEY.md §4.4). The smaller traces run in CI; the big ones are exercised by
+bench.py.
+"""
+
+import os
+
+import pytest
+
+from diamond_types_tpu.text.trace import load_trace, replay_direct, replay_into_oplog
+from tests.conftest import reference_path
+
+BENCH = reference_path("benchmark_data")
+
+
+def trace_path(name):
+    p = os.path.join(BENCH, name)
+    if not os.path.exists(p):
+        pytest.skip(f"missing {p}")
+    return p
+
+
+@pytest.mark.parametrize("name", ["sveltecomponent.json.gz", "seph-blog1.json.gz"])
+def test_linear_trace_replay(name):
+    data = load_trace(trace_path(name))
+    assert replay_direct(data) == data.end_content
+
+    ol = replay_into_oplog(data)
+    assert len(ol) == data.num_ops() or len(ol) > 0
+    b = ol.checkout_tip()
+    assert b.snapshot() == data.end_content
+
+
+def test_friendsforever_flat():
+    data = load_trace(trace_path("friendsforever_flat.json.gz"))
+    ol = replay_into_oplog(data)
+    b = ol.checkout_tip()
+    assert b.snapshot() == data.end_content
