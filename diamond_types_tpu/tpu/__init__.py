@@ -1,0 +1,9 @@
+"""Device tier: JAX/XLA kernels.
+
+LVs are int64 (documents can exceed 2^31 ops; underwater sentinels live at
+2^62), so x64 must be on before any tracing happens.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

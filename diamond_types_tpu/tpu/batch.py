@@ -1,0 +1,103 @@
+"""Batched multi-document op application (JAX/XLA).
+
+The TPU-parallel analogue of the reference's single-doc linear replay bench
+(reference: crates/bench/src/main.rs local/apply_*): instead of one document
+applying ops one at a time, N replicas apply their op streams simultaneously —
+`lax.scan` over op index, `vmap` over documents. This is the "batch/data
+parallelism = vmap over many documents per chip" axis from SURVEY.md §2.9.
+
+Document state is a fixed-capacity char-code buffer + length. One op step
+(pos, del_len, ins_len, ins_chars) rebuilds the buffer with vectorized index
+arithmetic (a gather), which XLA fuses into a single pass per step:
+
+    src_idx(i) = i                 for i <  pos
+               = i - ins + del     for i >= pos + ins   (tail shift)
+    insert lane writes ins_chars at [pos, pos+ins)
+
+Ops per document are padded to a common count; zero-length ops are no-ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def encode_trace_ops(txns, max_ins: int):
+    """Flatten a TestData-style patch list into dense op arrays, splitting
+    long inserts into <= max_ins chunks. Returns (pos, dlen, ilen, chars)."""
+    pos, dl, il, chars = [], [], [], []
+    for txn in txns:
+        for (p, d, ins) in txn:
+            if d:
+                pos.append(p)
+                dl.append(d)
+                il.append(0)
+                chars.append([0] * max_ins)
+            off = 0
+            while off < len(ins):
+                chunk = ins[off:off + max_ins]
+                pos.append(p + off)
+                dl.append(0)
+                il.append(len(chunk))
+                chars.append([ord(c) for c in chunk] + [0] * (max_ins - len(chunk)))
+                off += len(chunk)
+    return (np.asarray(pos, np.int32), np.asarray(dl, np.int32),
+            np.asarray(il, np.int32),
+            np.asarray(chars, np.int32).reshape(-1, max_ins))
+
+
+def apply_op_step(doc: jnp.ndarray, doc_len: jnp.ndarray,
+                  pos: jnp.ndarray, dlen: jnp.ndarray,
+                  ilen: jnp.ndarray, ins_chars: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply one positional op to one document buffer. All args are traced
+    scalars/vectors; `doc` is int32 [cap], `ins_chars` int32 [max_ins]."""
+    cap = doc.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    shift = ilen - dlen
+    # Where does each output slot read from?
+    src = jnp.where(idx < pos, idx, idx - shift)
+    in_insert = (idx >= pos) & (idx < pos + ilen)
+    gathered = doc[jnp.clip(src, 0, cap - 1)]
+    ins_vals = ins_chars[jnp.clip(idx - pos, 0, ins_chars.shape[0] - 1)]
+    new_doc = jnp.where(in_insert, ins_vals, gathered)
+    new_len = doc_len + shift
+    # Zero-length op => no-op
+    noop = (ilen == 0) & (dlen == 0)
+    return (jnp.where(noop, doc, new_doc),
+            jnp.where(noop, doc_len, new_len))
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def replay_batch(pos: jnp.ndarray, dlen: jnp.ndarray, ilen: jnp.ndarray,
+                 chars: jnp.ndarray, cap: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Replay [b, n] op streams into [b, cap] documents.
+
+    pos/dlen/ilen: int32 [b, n]; chars: int32 [b, n, max_ins].
+    Returns (docs [b, cap], lens [b]).
+    """
+    b = pos.shape[0]
+    docs0 = jnp.zeros((b, cap), dtype=jnp.int32)
+    lens0 = jnp.zeros((b,), dtype=jnp.int32)
+
+    def step(carry, op):
+        docs, lens = carry
+        p, d, i, c = op
+        docs, lens = jax.vmap(apply_op_step)(docs, lens, p, d, i, c)
+        return (docs, lens), None
+
+    ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
+           jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
+    (docs, lens), _ = jax.lax.scan(step, (docs0, lens0), ops)
+    return docs, lens
+
+
+def docs_to_strings(docs: np.ndarray, lens: np.ndarray) -> List[str]:
+    return ["".join(chr(c) for c in row[:n]) for row, n in
+            zip(np.asarray(docs), np.asarray(lens))]

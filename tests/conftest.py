@@ -3,11 +3,20 @@ import sys
 
 # Force a virtual 8-device CPU mesh for sharding tests; benches run separately
 # on real TPU hardware (see bench.py which clears these).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # virtual mesh for tests; bench.py uses the real chip
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's site hooks can force an accelerator platform regardless of
+# the env var, so pin the platform via the config API too (must run before the
+# backend initializes, i.e. before any jax.devices() call).
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

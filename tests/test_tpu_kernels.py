@@ -1,0 +1,147 @@
+"""Device-tier tests on the virtual 8-device CPU mesh (conftest sets
+JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from diamond_types_tpu.causalgraph.graph import Graph
+from tests.conftest import reference_path
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from diamond_types_tpu.tpu import graph_kernels as gk  # noqa: E402
+from diamond_types_tpu.tpu.batch import (docs_to_strings, encode_trace_ops,  # noqa: E402
+                                         replay_batch)
+
+
+def build_graph(hist):
+    g = Graph()
+    for e in hist:
+        g.push(e["parents"], e["span"][0], e["span"][1])
+    return g
+
+
+def load_cases(name):
+    path = os.path.join(reference_path("test_data", "causal_graph"), name)
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_device_contains_matches_golden_vectors():
+    cases = load_cases("version_contains.json")
+    # Group by identical graph to batch queries.
+    by_hist = {}
+    for c in cases:
+        by_hist.setdefault(json.dumps(c["hist"]), []).append(c)
+    for hist_s, group in by_hist.items():
+        g = build_graph(json.loads(hist_s))
+        fn = gk.make_contains_fn(g)
+        k = max(len(c["frontier"]) for c in group) or 1
+        frontiers = np.full((len(group), k), -1, dtype=np.int64)
+        targets = np.zeros((len(group),), dtype=np.int64)
+        for i, c in enumerate(group):
+            for j, v in enumerate(c["frontier"]):
+                frontiers[i, j] = v
+            targets[i] = c["target"] if c["target"] != -1 else -1
+        got = np.asarray(fn(jnp.asarray(frontiers), jnp.asarray(targets)))
+        for i, c in enumerate(group):
+            assert bool(got[i]) == c["expected"], (c, bool(got[i]))
+
+
+def test_device_diff_matches_host():
+    cases = load_cases("diff.json")
+    for c in cases:
+        g = build_graph(c["hist"])
+        packed = gk.pack_graph(g)
+        k = max(len(c["a"]), len(c["b"]), 1)
+
+        def pad(f):
+            return jnp.asarray(np.array(f + [-1] * (k - len(f)), dtype=np.int64))
+
+        ra, rb = gk.diff_masks(packed, pad(list(c["a"])), pad(list(c["b"])))
+        ra, rb = np.asarray(ra), np.asarray(rb)
+        # only_a = covered by a but not b, per run
+        only_a, only_b = [], []
+        for i in range(len(g.starts)):
+            s = g.starts[i]
+            a_hi, b_hi = int(ra[i]), int(rb[i])
+            if a_hi > b_hi:
+                lo = max(s, b_hi + 1)
+                if only_a and only_a[-1][1] == lo:
+                    only_a[-1] = (only_a[-1][0], a_hi + 1)
+                else:
+                    only_a.append((lo, a_hi + 1))
+            elif b_hi > a_hi:
+                lo = max(s, a_hi + 1)
+                if only_b and only_b[-1][1] == lo:
+                    only_b[-1] = (only_b[-1][0], b_hi + 1)
+                else:
+                    only_b.append((lo, b_hi + 1))
+        ea, eb = g.diff(c["a"], c["b"])
+        assert only_a == ea, (c, only_a, ea)
+        assert only_b == eb
+
+
+def test_batched_replay_matches_rope():
+    from diamond_types_tpu.text.trace import TestData, replay_direct
+    txns = [[(0, 0, "hello world")], [(5, 6, "")], [(5, 0, ", there")],
+            [(0, 1, "H")], [(12, 0, "!")]]
+    data = TestData("", "", txns)
+    expected = replay_direct(data)
+
+    pos, dl, il, chars = encode_trace_ops(txns, max_ins=16)
+    b = 8
+    docs, lens = replay_batch(
+        jnp.asarray(np.tile(pos, (b, 1))), jnp.asarray(np.tile(dl, (b, 1))),
+        jnp.asarray(np.tile(il, (b, 1))),
+        jnp.asarray(np.tile(chars, (b, 1, 1))), cap=64)
+    out = docs_to_strings(np.asarray(docs), np.asarray(lens))
+    assert all(s == expected for s in out)
+
+
+def test_sharded_replay_8_devices():
+    from diamond_types_tpu.parallel.mesh import make_mesh, sharded_replay
+    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
+    mesh = make_mesh(8)
+    txns = [[(0, 0, "abcdef")], [(2, 2, "XY")], [(0, 1, "")]]
+    pos, dl, il, chars = encode_trace_ops(txns, max_ins=8)
+    b = 16
+    docs, lens = sharded_replay(
+        mesh, np.tile(pos, (b, 1)), np.tile(dl, (b, 1)),
+        np.tile(il, (b, 1)), np.tile(chars, (b, 1, 1)), cap=32)
+    out = docs_to_strings(np.asarray(docs), np.asarray(lens))
+    assert all(s == "bXYef" for s in out), out
+
+
+def test_sharded_graph_propagation():
+    from diamond_types_tpu.parallel.mesh import (make_mesh,
+                                                 sharded_reach_fixed_point)
+    # Fan-in DAG: 16 root runs all merged by one run; pad to multiple of 8.
+    g = Graph()
+    for i in range(16):
+        g.push([], i * 10, i * 10 + 10)
+    g.push([i * 10 + 9 for i in range(16)], 160, 170)
+    # Pad runs to 24 (divisible by 8) with self-contained dummies.
+    packed = gk.pack_graph(g)
+    n = packed["n"]
+    pad_to = 24
+    starts = np.full((pad_to,), 1 << 61, dtype=np.int64)
+    starts[:n] = np.asarray(packed["starts"])
+    k = packed["parent_lv"].shape[1]
+    plv = np.full((pad_to, k), -1, dtype=np.int64)
+    plv[:n] = np.asarray(packed["parent_lv"])
+    prun = np.full((pad_to, k), pad_to, dtype=np.int32)
+    prun[:n] = np.minimum(np.asarray(packed["parent_run"]), pad_to)
+    reach0 = np.full((pad_to,), -1, dtype=np.int64)
+    reach0[16] = 169  # frontier at the merge tip
+
+    mesh = make_mesh(8, axis="graph")
+    reach = np.asarray(sharded_reach_fixed_point(
+        mesh, jnp.asarray(starts), jnp.asarray(plv), jnp.asarray(prun),
+        jnp.asarray(reach0)))
+    # Every root run must be fully covered.
+    assert all(reach[i] == i * 10 + 9 for i in range(16)), reach[:17]
