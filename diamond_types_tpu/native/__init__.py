@@ -1,0 +1,11 @@
+"""Native (C++) host core bindings via ctypes.
+
+The hot merge path (graph queries + spanning-tree walk + treap tracker +
+transform pipeline) is implemented in native/dt_core.cpp, mirroring how the
+reference implements its host tier in Rust. Python falls back to the pure
+implementation in diamond_types_tpu.listmerge when the shared library isn't
+built. Build with: python -m diamond_types_tpu.native.build
+"""
+
+from .core import (NativeContext, merge_native, native_available,  # noqa: F401
+                   transform_native)

@@ -1,0 +1,35 @@
+"""Build the native host core: g++ -O2 -shared -fPIC native/dt_core.cpp."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SRC = os.path.join(REPO, "native", "dt_core.cpp")
+OUT = os.path.join(REPO, "native", "libdt_core.so")
+
+
+def build(force: bool = False) -> str | None:
+    if not os.path.exists(SRC):
+        return None
+    if not force and os.path.exists(OUT) and \
+            os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+        return OUT
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-DNDEBUG",
+           SRC, "-o", OUT]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        sys.stderr.write(f"native build failed: {e}\n")
+        if hasattr(e, "stderr") and e.stderr:
+            sys.stderr.write(e.stderr[:2000] + "\n")
+        return None
+    return OUT
+
+
+if __name__ == "__main__":
+    out = build(force="--force" in sys.argv)
+    print(out or "BUILD FAILED")
+    sys.exit(0 if out else 1)

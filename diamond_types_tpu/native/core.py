@@ -1,0 +1,199 @@
+"""ctypes wrapper over native/libdt_core.so."""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .build import OUT as _SO_PATH, build as _build
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _build()
+    if path is None or not os.path.exists(path):
+        return None
+    lib = ct.CDLL(path)
+    lib.dt_ctx_new.restype = ct.c_void_p
+    lib.dt_ctx_free.argtypes = [ct.c_void_p]
+    lib.dt_add_agent.argtypes = [ct.c_void_p, ct.c_char_p]
+    lib.dt_load_graph.argtypes = [ct.c_void_p, ct.c_int64] + [
+        np.ctypeslib.ndpointer(np.int64, flags="C")] * 5
+    lib.dt_load_agent_runs.argtypes = [ct.c_void_p, ct.c_int64] + [
+        np.ctypeslib.ndpointer(np.int64, flags="C")] * 4
+    lib.dt_load_ops.argtypes = [
+        ct.c_void_p, ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_load_ins_arena.argtypes = [
+        ct.c_void_p, ct.c_int64, np.ctypeslib.ndpointer(np.int32, flags="C")]
+    lib.dt_merge_into_doc.argtypes = [
+        ct.c_void_p, np.ctypeslib.ndpointer(np.int32, flags="C"), ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
+    lib.dt_merge_into_doc.restype = ct.c_int64
+    lib.dt_get_doc.argtypes = [
+        ct.c_void_p, np.ctypeslib.ndpointer(np.int32, flags="C")]
+    lib.dt_transform.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
+    lib.dt_transform.restype = ct.c_int64
+    lib.dt_get_out.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_get_out_frontier.argtypes = [
+        ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
+    lib.dt_get_out_frontier.restype = ct.c_int64
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeContext:
+    """A C++ mirror of an OpLog's merge-relevant state (graph, agent runs,
+    op runs). Rebuilt lazily when the oplog grows."""
+
+    def __init__(self, oplog) -> None:
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        self._ptr = lib.dt_ctx_new()
+        self._built_len = -1
+        self._oplog = oplog
+
+    def __del__(self):
+        try:
+            self._lib.dt_ctx_free(self._ptr)
+        except Exception:
+            pass
+
+    def sync(self) -> None:
+        ol = self._oplog
+        if self._built_len == len(ol):
+            return
+        lib = self._lib
+        # Rebuild from scratch (bulk load is cheap: O(n) columnar copies).
+        lib.dt_ctx_free(self._ptr)
+        self._ptr = lib.dt_ctx_new()
+        for name in ol.cg.agent_assignment.agent_names:
+            lib.dt_add_agent(self._ptr, name.encode("utf8"))
+        g = ol.cg.graph
+        starts, ends, shadows, indptr, flat = g.as_arrays()
+        if flat.size == 0:
+            flat = np.zeros(1, dtype=np.int64)
+        lib.dt_load_graph(self._ptr, len(starts),
+                          np.ascontiguousarray(starts),
+                          np.ascontiguousarray(ends),
+                          np.ascontiguousarray(shadows),
+                          np.ascontiguousarray(indptr),
+                          np.ascontiguousarray(flat))
+        gr = ol.cg.agent_assignment.global_runs
+        lv0 = np.asarray([r[0] for r in gr], dtype=np.int64)
+        lv1 = np.asarray([r[1] for r in gr], dtype=np.int64)
+        ag = np.asarray([r[2] for r in gr], dtype=np.int64)
+        sq = np.asarray([r[3] for r in gr], dtype=np.int64)
+        lib.dt_load_agent_runs(self._ptr, len(gr), lv0, lv1, ag, sq)
+        runs = ol.ops.runs
+        lv = np.asarray([r.lv for r in runs], dtype=np.int64)
+        kind = np.asarray([r.kind for r in runs], dtype=np.uint8)
+        fwd = np.asarray([1 if r.fwd else 0 for r in runs], dtype=np.uint8)
+        st = np.asarray([r.start for r in runs], dtype=np.int64)
+        en = np.asarray([r.end for r in runs], dtype=np.int64)
+        cp = np.asarray(
+            [r.content_pos[0] if r.content_pos is not None else -1
+             for r in runs], dtype=np.int64)
+        lib.dt_load_ops(self._ptr, len(runs), lv, kind, fwd, st, en, cp)
+        from ..text.op import INS
+        arena_str = ol.ops._arenas[INS].get((0, ol.ops.arena_len(INS)))
+        arena = np.frombuffer(arena_str.encode("utf-32-le"), dtype=np.int32)
+        if arena.size == 0:
+            arena = np.zeros(1, dtype=np.int32)
+        lib.dt_load_ins_arena(self._ptr, len(arena_str),
+                              np.ascontiguousarray(arena))
+        self._built_len = len(ol)
+
+    def transform(self, from_frontier: Sequence[int],
+                  merge_frontier: Sequence[int]):
+        """Returns (lv, len, kind, fwd, pos arrays, final_frontier)."""
+        self.sync()
+        lib = self._lib
+        f = np.asarray(sorted(from_frontier), dtype=np.int64)
+        m = np.asarray(sorted(merge_frontier), dtype=np.int64)
+        if f.size == 0:
+            f = np.zeros(0, dtype=np.int64)
+        if m.size == 0:
+            m = np.zeros(0, dtype=np.int64)
+        n = lib.dt_transform(self._ptr, np.ascontiguousarray(f), len(f),
+                             np.ascontiguousarray(m), len(m))
+        lv = np.empty(n, dtype=np.int64)
+        ln = np.empty(n, dtype=np.int64)
+        kind = np.empty(n, dtype=np.uint8)
+        fwd = np.empty(n, dtype=np.uint8)
+        pos = np.empty(n, dtype=np.int64)
+        if n:
+            lib.dt_get_out(self._ptr, lv, ln, kind, fwd, pos)
+        fbuf = np.empty(16, dtype=np.int64)
+        k = lib.dt_get_out_frontier(self._ptr, fbuf, 16)
+        if k > 16:
+            fbuf = np.empty(k, dtype=np.int64)
+            lib.dt_get_out_frontier(self._ptr, fbuf, k)
+        frontier = [int(x) for x in fbuf[:k]]
+        return lv, ln, kind, fwd, pos, frontier
+
+
+    def merge_to_string(self, init: str, from_frontier: Sequence[int],
+                        merge_frontier: Sequence[int]):
+        """Full native merge: returns (final_doc_str, final_frontier)."""
+        self.sync()
+        lib = self._lib
+        init_arr = np.frombuffer(init.encode("utf-32-le"), dtype=np.int32)
+        if init_arr.size == 0:
+            init_arr = np.zeros(1, dtype=np.int32)
+        f = np.ascontiguousarray(np.asarray(sorted(from_frontier), dtype=np.int64))
+        m = np.ascontiguousarray(np.asarray(sorted(merge_frontier), dtype=np.int64))
+        n = lib.dt_merge_into_doc(self._ptr, np.ascontiguousarray(init_arr),
+                                  len(init), f, len(f), m, len(m))
+        out = np.empty(max(int(n), 1), dtype=np.int32)
+        lib.dt_get_doc(self._ptr, out)
+        doc = out[:n].tobytes().decode("utf-32-le")
+        fbuf = np.empty(64, dtype=np.int64)
+        k = lib.dt_get_out_frontier(self._ptr, fbuf, 64)
+        if k > 64:
+            fbuf = np.empty(k, dtype=np.int64)
+            lib.dt_get_out_frontier(self._ptr, fbuf, k)
+        return doc, [int(x) for x in fbuf[:k]]
+
+
+def merge_native(oplog, init: str, from_frontier, merge_frontier):
+    ctx = getattr(oplog, "_native_ctx", None)
+    if ctx is None:
+        ctx = NativeContext(oplog)
+        oplog._native_ctx = ctx
+    return ctx.merge_to_string(init, from_frontier, merge_frontier)
+
+
+def transform_native(oplog, from_frontier, merge_frontier):
+    ctx = getattr(oplog, "_native_ctx", None)
+    if ctx is None:
+        ctx = NativeContext(oplog)
+        oplog._native_ctx = ctx
+    return ctx.transform(from_frontier, merge_frontier)

@@ -6,6 +6,7 @@ src/list/branch.rs, src/list/merge.rs:63-96).
 
 from __future__ import annotations
 
+import os
 from typing import List, Sequence
 
 from ..utils.rope import Rope
@@ -52,7 +53,20 @@ class Branch:
 
     def merge(self, oplog: OpLog, merge_frontier: Sequence[int]) -> None:
         """Bring everything in `merge_frontier`'s history into this branch
-        (reference: src/list/merge.rs:63-96)."""
+        (reference: src/list/merge.rs:63-96).
+
+        Uses the C++ host core when built (same algorithm, ~2 orders of
+        magnitude faster); set DT_TPU_NO_NATIVE=1 to force the Python engine.
+        """
+        if not os.environ.get("DT_TPU_NO_NATIVE"):
+            from ..native import merge_native, native_available
+            if native_available():
+                doc, frontier = merge_native(oplog, self.snapshot(),
+                                             self.version, merge_frontier)
+                self.content = Rope(doc)
+                self.version = frontier
+                return
+
         xf = oplog.get_xf_operations_full(self.version, merge_frontier)
         for _lv, op, pos in xf:
             if pos is None:

@@ -89,6 +89,16 @@ class OpStore:
             raise KeyError(lv)
         return i
 
+    def content_slice(self, lv: int, n: int) -> Optional[str]:
+        """Content chars for items [lv, lv+n) of the run containing lv."""
+        run = self.runs[self.find_idx(lv)]
+        if run.content_pos is None:
+            return None
+        off = lv - run.lv
+        assert off + n <= len(run)
+        base = run.content_pos[0]
+        return self._arenas[run.kind].get((base + off, base + off + n))
+
     def end_lv(self) -> int:
         if not self.runs:
             return 0

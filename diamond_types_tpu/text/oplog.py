@@ -17,12 +17,13 @@ from .op import DEL, INS, OpRun, OpStore
 
 
 class OpLog:
-    __slots__ = ("cg", "ops", "doc_id")
+    __slots__ = ("cg", "ops", "doc_id", "_native_ctx")
 
     def __init__(self) -> None:
         self.cg = CausalGraph()
         self.ops = OpStore()
         self.doc_id: Optional[str] = None
+        self._native_ctx = None
 
     def __len__(self) -> int:
         return len(self.cg)

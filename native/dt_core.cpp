@@ -1,0 +1,1151 @@
+// dt_core — native host core for diamond_types_tpu.
+//
+// Implements the merge-critical host path in C++ (the reference implements
+// this tier in Rust; see SURVEY.md §2 native-component note):
+//   * columnar causal graph + DAG queries (diff / find_conflicting)
+//     (reference: src/causalgraph/graph/tools.rs)
+//   * frontier movement (reference: src/frontier.rs)
+//   * spanning-tree conflict walker (reference: src/listmerge/txn_trace.rs)
+//   * treap-based merge tracker with dual current/upstream aggregates and
+//     YjsMod integrate (reference: src/listmerge/merge.rs, yjsspan.rs,
+//     advance_retreat.rs — same design as the Python tracker in
+//     diamond_types_tpu/listmerge/tracker.py)
+//   * the transformed-op pipeline incl. fast-forward mode
+//     (reference: src/listmerge/merge.rs:585-941)
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// Content (text) stays on the Python side; this library deals purely in
+// LV spans and positions.
+
+#include <algorithm>
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <string>
+#include <vector>
+
+typedef int64_t i64;
+typedef uint8_t u8;
+
+static const i64 ROOT = -1;
+static const i64 UNDERWATER = 1ll << 62;
+
+// ---------------------------------------------------------------- utilities
+
+struct Span { i64 start, end; };
+
+static inline bool span_empty(const Span& s) { return s.end <= s.start; }
+
+static void push_reversed_rle(std::vector<Span>& out, Span s) {
+  if (!out.empty() && s.end == out.back().start) out.back().start = s.start;
+  else out.push_back(s);
+}
+
+// ---------------------------------------------------------------- graph
+
+struct Graph {
+  std::vector<i64> starts, ends, shadows;
+  std::vector<std::vector<i64>> parents;
+
+  size_t find_idx(i64 v) const {
+    size_t lo = 0, hi = starts.size();
+    while (lo < hi) { size_t mid = (lo + hi) / 2;
+      if (starts[mid] <= v) lo = mid + 1; else hi = mid; }
+    return lo - 1;
+  }
+
+  void parents_at(i64 v, std::vector<i64>& out) const {
+    size_t i = find_idx(v);
+    out.clear();
+    if (v > starts[i]) out.push_back(v - 1);
+    else out = parents[i];
+  }
+
+  bool entry_contains(size_t idx, i64 v) const {
+    return starts[idx] <= v && v < ends[idx];
+  }
+
+  bool is_direct_descendant_coarse(i64 a, i64 b) const {
+    if (a == b || b == ROOT) return true;
+    return a > b && entry_contains(find_idx(a), b);
+  }
+
+  bool frontier_contains_version(const std::vector<i64>& f, i64 target) const {
+    if (target == ROOT) return true;
+    for (i64 o : f) if (o == target) return true;
+    if (f.empty()) return false;
+    for (i64 o : f) if (o > target && shadows[find_idx(o)] <= target) return true;
+    std::priority_queue<i64> q;
+    for (i64 o : f) if (o > target) q.push(o);
+    while (!q.empty()) {
+      i64 order = q.top(); q.pop();
+      size_t i = find_idx(order);
+      if (shadows[i] <= target) return true;
+      i64 start = starts[i];
+      while (!q.empty() && q.top() >= start) q.pop();
+      for (i64 p : parents[i]) {
+        if (p == target) return true;
+        if (p > target) q.push(p);
+      }
+    }
+    return false;
+  }
+
+  // diff: returns (only_a, only_b) in DESCENDING order.
+  enum Flag : u8 { OnlyA = 0, OnlyB = 1, Shared = 2 };
+
+  void diff_rev(const std::vector<i64>& a, const std::vector<i64>& b,
+                std::vector<Span>& only_a, std::vector<Span>& only_b) const {
+    only_a.clear(); only_b.clear();
+    if (a == b) return;
+    if (a.size() == 1 && b.size() == 1) {
+      if (is_direct_descendant_coarse(a[0], b[0])) {
+        if (a[0] != b[0]) only_a.push_back({b[0] + 1, a[0] + 1});
+        return;
+      }
+      if (is_direct_descendant_coarse(b[0], a[0])) {
+        only_b.push_back({a[0] + 1, b[0] + 1});
+        return;
+      }
+    }
+    diff_slow(a, b, only_a, only_b);
+  }
+
+  void diff_slow(const std::vector<i64>& a, const std::vector<i64>& b,
+                 std::vector<Span>& only_a, std::vector<Span>& only_b) const {
+    // max-heap of (lv, flag)
+    std::priority_queue<std::pair<i64, u8>> q;
+    for (i64 v : a) q.push({v, OnlyA});
+    for (i64 v : b) q.push({v, OnlyB});
+    long num_shared = 0;
+
+    auto mark = [&](i64 lo, i64 hi, u8 flag) {
+      if (flag == Shared) return;
+      push_reversed_rle(flag == OnlyA ? only_a : only_b, {lo, hi + 1});
+    };
+
+    while (!q.empty()) {
+      auto [ord, flag] = q.top(); q.pop();
+      if (flag == Shared) num_shared--;
+      while (!q.empty() && q.top().first == ord) {
+        u8 pf = q.top().second; q.pop();
+        if (pf != flag) flag = Shared;
+        if (pf == Shared) num_shared--;
+      }
+      size_t i = find_idx(ord);
+      i64 start = starts[i];
+      while (!q.empty() && q.top().first >= start) {
+        i64 peek_ord = q.top().first; u8 pf = q.top().second;
+        if (pf != flag) {
+          mark(peek_ord + 1, ord, flag);
+          ord = peek_ord;
+          flag = Shared;
+        }
+        if (pf == Shared) num_shared--;
+        q.pop();
+      }
+      mark(start, ord, flag);
+      for (i64 p : parents[i]) {
+        q.push({p, flag});
+        if (flag == Shared) num_shared++;
+      }
+      if ((long)q.size() == num_shared) break;
+    }
+  }
+
+  // find_conflicting; visits spans (descending); returns common ancestor.
+  template <class V>
+  std::vector<i64> find_conflicting(const std::vector<i64>& a,
+                                    const std::vector<i64>& b, V visit) const {
+    if (a == b) return a;
+    if (a.size() == 1 && b.size() == 1) {
+      if (is_direct_descendant_coarse(a[0], b[0])) {
+        if (a[0] != b[0]) visit(Span{b[0] + 1, a[0] + 1}, (u8)OnlyA);
+        return b[0] == ROOT ? std::vector<i64>{} : std::vector<i64>{b[0]};
+      }
+      if (is_direct_descendant_coarse(b[0], a[0])) {
+        visit(Span{a[0] + 1, b[0] + 1}, (u8)OnlyB);
+        return a[0] == ROOT ? std::vector<i64>{} : std::vector<i64>{a[0]};
+      }
+    }
+    return find_conflicting_slow(a, b, visit);
+  }
+
+  struct TimePoint {
+    i64 last;
+    std::vector<i64> merged;  // sorted, excludes last
+    bool operator==(const TimePoint& o) const {
+      return last == o.last && merged == o.merged;
+    }
+    // max-heap: highest last first; among equal, FEWER merged first.
+    bool operator<(const TimePoint& o) const {
+      if (last != o.last) return last < o.last;
+      if (merged.size() != o.merged.size()) return merged.size() > o.merged.size();
+      return merged < o.merged;
+    }
+  };
+
+  template <class V>
+  std::vector<i64> find_conflicting_slow(const std::vector<i64>& a,
+                                         const std::vector<i64>& b,
+                                         V visit) const {
+    auto tp = [](const std::vector<i64>& f) {
+      TimePoint t;
+      if (f.empty()) { t.last = ROOT; return t; }
+      t.last = f.back();
+      t.merged.assign(f.begin(), f.end() - 1);
+      return t;
+    };
+    std::priority_queue<std::pair<TimePoint, u8>> q;
+    q.push({tp(a), OnlyA});
+    q.push({tp(b), OnlyB});
+
+    while (true) {
+      auto [time, flag] = q.top(); q.pop();
+      i64 t = time.last;
+      if (t == ROOT) return {};
+      while (!q.empty() && q.top().first == time) {
+        if (q.top().second != flag) flag = Shared;
+        q.pop();
+      }
+      if (q.empty()) {
+        std::vector<i64> fr = time.merged;
+        fr.push_back(t);
+        return fr;
+      }
+      for (i64 t2 : time.merged) q.push({TimePoint{t2, {}}, flag});
+      size_t i = find_idx(t);
+      Span rng{starts[i], t + 1};
+      while (true) {
+        if (!q.empty()) {
+          const TimePoint& peek = q.top().first;
+          if (peek.last != ROOT && peek.last >= starts[i]) {
+            auto [time2, next_flag] = q.top(); q.pop();
+            if (time2.last + 1 < rng.end) {
+              i64 offset = time2.last + 1 - starts[i];
+              Span rem{starts[i] + offset, rng.end};
+              rng = {starts[i], starts[i] + offset};
+              visit(rem, flag);
+            }
+            for (i64 t2 : time2.merged) q.push({TimePoint{t2, {}}, next_flag});
+            if (next_flag != flag) flag = Shared;
+          } else {
+            visit(rng, flag);
+            q.push({tp(parents[i]), flag});
+            break;
+          }
+        } else {
+          return {rng.end - 1};
+        }
+      }
+    }
+  }
+
+  // frontier ops (reference: src/frontier.rs)
+  void advance_known_run(std::vector<i64>& f, const std::vector<i64>& ps,
+                         Span span) const {
+    i64 last = span.end - 1;
+    if (ps.size() == 1 && f.size() == 1 && ps[0] == f[0]) { f[0] = last; return; }
+    if (f == ps) { f.assign(1, last); return; }
+    std::vector<i64> out;
+    for (i64 o : f)
+      if (std::find(ps.begin(), ps.end(), o) == ps.end()) out.push_back(o);
+    out.insert(std::upper_bound(out.begin(), out.end(), last), last);
+    f = out;
+  }
+
+  void advance(std::vector<i64>& f, Span rng) const {
+    i64 start = rng.start;
+    size_t i = find_idx(start);
+    std::vector<i64> ps;
+    while (true) {
+      i64 e_end = std::min(ends[i], rng.end);
+      parents_at(start, ps);
+      advance_known_run(f, ps, {start, e_end});
+      if (e_end >= rng.end) break;
+      start = e_end;
+      i++;
+    }
+  }
+
+  void retreat(std::vector<i64>& f, Span rng) const {
+    if (span_empty(rng)) return;
+    i64 start = rng.start, end = rng.end;
+    size_t i = find_idx(end - 1);
+    std::vector<i64> ps;
+    while (true) {
+      i64 last_order = end - 1;
+      i64 t_start = starts[i];
+      if (f.size() == 1) {
+        if (start > t_start) { f[0] = start - 1; break; }
+        f = parents[i];
+      } else {
+        f.erase(std::remove(f.begin(), f.end(), last_order), f.end());
+        parents_at(std::max(start, t_start), ps);
+        for (i64 p : ps) {
+          if (!frontier_contains_version(f, p))
+            f.insert(std::upper_bound(f.begin(), f.end(), p), p);
+        }
+      }
+      if (start >= t_start) break;
+      end = t_start;
+      i--;
+    }
+  }
+};
+
+// ---------------------------------------------------------------- agents
+
+struct AgentRun { i64 seq_start, seq_end, lv_start; };
+
+struct Agents {
+  std::vector<std::string> names;
+  std::vector<std::vector<AgentRun>> client_runs;
+  // global: (lv_start, lv_end, agent, seq_start), lv-sorted
+  struct GRun { i64 lv0, lv1; i64 agent, seq0; };
+  std::vector<GRun> global_runs;
+
+  const GRun& find_global(i64 lv) const {
+    size_t lo = 0, hi = global_runs.size();
+    while (lo < hi) { size_t mid = (lo + hi) / 2;
+      if (global_runs[mid].lv0 <= lv) lo = mid + 1; else hi = mid; }
+    return global_runs[lo - 1];
+  }
+
+  void local_to_agent(i64 lv, i64& agent, i64& seq) const {
+    const GRun& g = find_global(lv);
+    agent = g.agent;
+    seq = g.seq0 + (lv - g.lv0);
+  }
+
+  i64 span_len(i64 lv, i64 max_len) const {
+    const GRun& g = find_global(lv);
+    return std::min(g.lv1 - lv, max_len);
+  }
+};
+
+// ---------------------------------------------------------------- op store
+
+struct OpRun { i64 lv; u8 kind; u8 fwd; i64 start, end; i64 cp; };
+static const u8 INS = 0, DEL = 1;
+
+struct Ops {
+  std::vector<OpRun> runs;
+
+  size_t find_idx(i64 lv) const {
+    size_t lo = 0, hi = runs.size();
+    while (lo < hi) { size_t mid = (lo + hi) / 2;
+      if (runs[mid].lv <= lv) lo = mid + 1; else hi = mid; }
+    return lo - 1;
+  }
+
+  // sub-run covering item offsets [o0, o1) of run r
+  static OpRun slice(const OpRun& r, i64 o0, i64 o1) {
+    i64 n = r.end - r.start;
+    if (o0 == 0 && o1 == n) return r;
+    OpRun out = r;
+    out.lv = r.lv + o0;
+    if (r.cp >= 0) out.cp = r.cp + o0;
+    i64 s, e;
+    if (r.kind == INS) {
+      s = r.start + o0; e = s + (o1 - o0);
+    } else if (r.fwd) {
+      s = r.start; e = s + (o1 - o0);
+    } else {
+      s = r.end - o1; e = r.end - o0;
+    }
+    out.start = s; out.end = e;
+    return out;
+  }
+};
+
+// ---------------------------------------------------------------- tracker
+
+struct Node {
+  i64 ids, ide, ol, orr;
+  int32_t state;  // 0 NIY, 1 inserted, >=2 deleted (state-1) times
+  bool ever;
+  uint32_t prio;
+  Node *l = nullptr, *r = nullptr, *p = nullptr;
+  i64 s_len, s_cur, s_up;
+
+  inline i64 n_len() const { return ide - ids; }
+  inline i64 n_cur() const { return state == 1 ? ide - ids : 0; }
+  inline i64 n_up() const { return ever ? 0 : ide - ids; }
+  inline i64 origin_left_at(i64 off) const { return off == 0 ? ol : ids + off - 1; }
+};
+
+static inline void upd(Node* n) {
+  i64 ln = 0, lc = 0, lu = 0, rn = 0, rc = 0, ru = 0;
+  if (n->l) { ln = n->l->s_len; lc = n->l->s_cur; lu = n->l->s_up; }
+  if (n->r) { rn = n->r->s_len; rc = n->r->s_cur; ru = n->r->s_up; }
+  n->s_len = ln + rn + n->n_len();
+  n->s_cur = lc + rc + n->n_cur();
+  n->s_up = lu + ru + n->n_up();
+}
+
+static inline void fix_path(Node* n) { while (n) { upd(n); n = n->p; } }
+
+static Node* leftmost(Node* n) { while (n->l) n = n->l; return n; }
+
+static Node* succ(Node* n) {
+  if (n->r) return leftmost(n->r);
+  while (n->p && n == n->p->r) n = n->p;
+  return n->p;
+}
+
+static Node* pred(Node* n) {
+  if (n->l) { Node* x = n->l; while (x->r) x = x->r; return x; }
+  while (n->p && n == n->p->l) n = n->p;
+  return n->p;
+}
+
+struct Cursor { Node* node; i64 off; };  // node==nullptr => end of doc
+
+struct DelRow { i64 lv0, lv1, t0, t1; bool fwd; };
+
+struct Tracker {
+  std::vector<Node*> pool;
+  Node* root;
+  // ins index: id_start -> node (covers underwater)
+  std::map<i64, Node*> ins_index;
+  std::map<i64, DelRow> del_rows;  // keyed by lv0
+  uint64_t rng_state = 0x5EED5EED12345678ull;
+
+  uint32_t next_prio() {
+    rng_state ^= rng_state << 13; rng_state ^= rng_state >> 7;
+    rng_state ^= rng_state << 17;
+    return (uint32_t)rng_state;
+  }
+
+  Node* alloc(i64 ids, i64 ide, i64 ol, i64 orr, int32_t state, bool ever) {
+    Node* n = new Node();
+    n->ids = ids; n->ide = ide; n->ol = ol; n->orr = orr;
+    n->state = state; n->ever = ever;
+    n->prio = next_prio();
+    upd(n);
+    pool.push_back(n);
+    return n;
+  }
+
+  Tracker() {
+    root = alloc(UNDERWATER, UNDERWATER * 2 - 1, ROOT, ROOT, 1, false);
+    ins_index[root->ids] = root;
+  }
+  ~Tracker() { for (Node* n : pool) delete n; }
+
+  void reg(Node* n) { ins_index[n->ids] = n; }
+
+  Node* ins_lookup(i64 lv) const {
+    auto it = ins_index.upper_bound(lv);
+    --it;
+    Node* n = it->second;
+    assert(n->ids <= lv && lv < n->ide);
+    return n;
+  }
+
+  void rot_up(Node* x) {
+    Node* p = x->p;
+    Node* g = p->p;
+    if (x == p->l) {
+      p->l = x->r; if (x->r) x->r->p = p;
+      x->r = p;
+    } else {
+      p->r = x->l; if (x->l) x->l->p = p;
+      x->l = p;
+    }
+    p->p = x; x->p = g;
+    if (g) { if (g->l == p) g->l = x; else g->r = x; }
+    else root = x;
+    upd(p); upd(x);
+  }
+
+  void insert_leaf(Node* x) {
+    fix_path(x->p);
+    while (x->p && x->prio < x->p->prio) rot_up(x);
+  }
+
+  void insert_after(Node* a, Node* x) {
+    if (!a->r) { a->r = x; x->p = a; }
+    else { Node* b = leftmost(a->r); b->l = x; x->p = b; }
+    insert_leaf(x);
+  }
+
+  void insert_first(Node* x) {
+    Node* b = leftmost(root);
+    b->l = x; x->p = b;
+    insert_leaf(x);
+  }
+
+  Node* split(Node* n, i64 off) {
+    assert(0 < off && off < n->n_len());
+    Node* rn = alloc(n->ids + off, n->ide, n->ids + off - 1, n->orr,
+                     n->state, n->ever);
+    n->ide = n->ids + off;
+    fix_path(n);
+    insert_after(n, rn);
+    reg(rn);
+    return rn;
+  }
+
+  i64 prefix(const Node* n, int which) const {
+    auto sub = [&](const Node* x) -> i64 {
+      if (!x) return 0;
+      return which == 0 ? x->s_len : which == 1 ? x->s_cur : x->s_up;
+    };
+    auto own = [&](const Node* x) -> i64 {
+      return which == 0 ? x->n_len() : which == 1 ? x->n_cur() : x->n_up();
+    };
+    i64 acc = sub(n->l);
+    const Node* x = n;
+    while (x->p) {
+      if (x == x->p->r) acc += sub(x->p->l) + own(x->p);
+      x = x->p;
+    }
+    return acc;
+  }
+
+  i64 raw_pos(Cursor c) const {
+    if (!c.node) return root->s_len;
+    return prefix(c.node, 0) + c.off;
+  }
+
+  i64 upstream_pos(Cursor c) const {
+    if (!c.node) return root->s_up;
+    return prefix(c.node, 2) + (c.node->ever ? 0 : c.off);
+  }
+
+  Cursor find_by_cur(i64 pos) const {
+    Node* n = root;
+    assert(pos < n->s_cur);
+    while (true) {
+      i64 lc = n->l ? n->l->s_cur : 0;
+      if (pos < lc) { n = n->l; continue; }
+      pos -= lc;
+      i64 here = n->n_cur();
+      if (pos < here) return {n, pos};
+      pos -= here;
+      n = n->r;
+    }
+  }
+
+  // normalize so off < len; {nullptr,0} at end of doc
+  bool roll(Cursor& c) const {
+    if (!c.node) return false;
+    while (c.off >= c.node->n_len()) {
+      Node* nx = succ(c.node);
+      if (!nx) { c.node = nullptr; c.off = 0; return false; }
+      c.node = nx; c.off = 0;
+    }
+    return true;
+  }
+
+  Cursor cursor_before_item(i64 lv) const {
+    if (lv == ROOT) return {nullptr, 0};  // end sentinel
+    Node* n = ins_lookup(lv);
+    return {n, lv - n->ids};
+  }
+
+  Cursor cursor_after_item(i64 lv) const {
+    if (lv == ROOT) return {leftmost(root), 0};
+    Node* n = ins_lookup(lv);
+    Cursor c{n, lv - n->ids + 1};
+    roll(c);
+    return c;
+  }
+
+  int cmp_cursors(Cursor a, Cursor b) const {
+    i64 pa = raw_pos(a), pb = raw_pos(b);
+    return pa < pb ? -1 : pa > pb ? 1 : 0;
+  }
+
+  void insert_at(Cursor c, Node* node) {
+    if (!c.node) {
+      Node* x = root; while (x->r) x = x->r;
+      insert_after(x, node);
+    } else if (c.off == 0) {
+      Node* pv = pred(c.node);
+      if (!pv) insert_first(node);
+      else insert_after(pv, node);
+    } else if (c.off == c.node->n_len()) {
+      insert_after(c.node, node);
+    } else {
+      split(c.node, c.off);
+      insert_after(c.node, node);
+    }
+    reg(node);
+  }
+
+  i64 integrate(const Agents& aa, i64 agent, Node* item, Cursor cursor) {
+    bool at_end = !roll(cursor);
+    Cursor left_cursor = cursor;
+    Cursor scan_start = cursor;
+    bool scanning = false;
+
+    while (!at_end && cursor.node) {
+      if (!roll(cursor)) break;
+      Node* other = cursor.node;
+      i64 off = cursor.off;
+      i64 other_lv = other->ids + off;
+      if (other_lv == item->orr) break;
+      assert(other->state == 0);
+
+      i64 other_left_lv = other->origin_left_at(off);
+      Cursor olc = cursor_after_item(other_left_lv);
+      int c = cmp_cursors(olc, left_cursor);
+      if (c < 0) break;
+      if (c == 0) {
+        if (item->orr == other->orr) {
+          i64 oa, oseq;
+          aa.local_to_agent(other_lv, oa, oseq);
+          const std::string& my_name = aa.names[agent];
+          const std::string& other_name = aa.names[oa];
+          bool ins_here;
+          if (my_name < other_name) ins_here = true;
+          else if (my_name == other_name) {
+            i64 ma, mseq;
+            aa.local_to_agent(item->ids, ma, mseq);
+            ins_here = mseq < oseq;
+          } else ins_here = false;
+          if (ins_here) break;
+          scanning = false;
+        } else {
+          Cursor mr = cursor_before_item(item->orr);
+          Cursor orc = cursor_before_item(other->orr);
+          if (cmp_cursors(orc, mr) < 0) {
+            if (!scanning) { scanning = true; scan_start = cursor; }
+          } else scanning = false;
+        }
+      }
+      Node* nx = succ(other);
+      if (!nx) { cursor = {other, other->n_len()}; break; }
+      cursor = {nx, 0};
+    }
+    if (scanning) cursor = scan_start;
+    Cursor at = cursor.node ? cursor : Cursor{nullptr, 0};
+    i64 pos = upstream_pos(at);
+    insert_at(at, item);
+    return pos;
+  }
+
+  // returns (consumed, xf_pos) — xf_pos = -1 => delete already happened
+  std::pair<i64, i64> apply(const Agents& aa, i64 agent, const OpRun& op,
+                            i64 max_len) {
+    i64 length = std::min(max_len, op.end - op.start);
+    if (op.kind == INS) {
+      assert(op.fwd && "reverse insert runs unsupported");
+      i64 origin_left;
+      Cursor cursor;
+      if (op.start == 0) {
+        origin_left = ROOT;
+        cursor = {leftmost(root), 0};
+      } else {
+        Cursor c = find_by_cur(op.start - 1);
+        origin_left = c.node->ids + c.off;
+        cursor = {c.node, c.off + 1};
+      }
+      // origin_right: next non-NIY item
+      Cursor c2 = cursor;
+      i64 origin_right = ROOT;
+      if (roll(c2)) {
+        while (true) {
+          if (c2.node->state == 0) {
+            Node* nx = succ(c2.node);
+            if (!nx) { origin_right = ROOT; break; }
+            c2 = {nx, 0};
+          } else { origin_right = c2.node->ids + c2.off; break; }
+        }
+      }
+      Node* item = alloc(op.lv, op.lv + length, origin_left, origin_right,
+                         1, false);
+      i64 pos = integrate(aa, agent, item, cursor);
+      return {length, pos};
+    } else {
+      bool fwd = op.fwd;
+      Cursor cursor;
+      i64 take_req;
+      if (fwd) {
+        cursor = find_by_cur(op.start);
+        take_req = length;
+      } else {
+        i64 last_pos = op.end - 1;
+        Cursor c = find_by_cur(last_pos);
+        i64 entry_start_pos = last_pos - c.off;
+        i64 edit_start = std::max(entry_start_pos, op.end - length);
+        take_req = op.end - edit_start;
+        cursor = {c.node, c.off - (take_req - 1)};
+      }
+      Node* n = cursor.node;
+      i64 off = cursor.off;
+      assert(n->state == 1);
+      bool ever_deleted = n->ever;
+      i64 del_start_xf = upstream_pos(cursor);
+      i64 take = std::min(take_req, n->n_len() - off);
+      if (off > 0) n = split(n, off);
+      if (take < n->n_len()) split(n, take);
+      i64 t0 = n->ids, t1 = n->ide;
+      n->state += 1;
+      n->ever = true;
+      fix_path(n);
+
+      del_rows[op.lv] = DelRow{op.lv, op.lv + take, t0, t1, fwd};
+      return {take, ever_deleted ? -1 : del_start_xf};
+    }
+  }
+
+  // ---- advance / retreat ----
+
+  struct QueryRes { u8 kind; i64 t0, t1; bool fwd; i64 offset, total; };
+
+  QueryRes index_query(i64 lv) const {
+    auto it = del_rows.upper_bound(lv);
+    if (it != del_rows.begin()) {
+      const DelRow& r = std::prev(it)->second;
+      if (r.lv0 <= lv && lv < r.lv1)
+        return {DEL, r.t0, r.t1, r.fwd, lv - r.lv0, r.lv1 - r.lv0};
+    }
+    Node* n = ins_lookup(lv);
+    return {INS, n->ids, n->ide, true, lv - n->ids, n->n_len()};
+  }
+
+  static void rr_sub(i64 t0, i64 t1, bool fwd, i64 o0, i64 o1,
+                     i64& lo, i64& hi) {
+    if (fwd) { lo = t0 + o0; hi = t0 + o1; }
+    else { lo = t1 - o1; hi = t1 - o0; }
+  }
+
+  void toggle_items(i64 s, i64 e, int mode) {
+    // modes: 0 ins, 1 unins, 2 del, 3 undel
+    i64 lv = s;
+    while (lv < e) {
+      Node* n = ins_lookup(lv);
+      if (lv > n->ids) n = split(n, lv - n->ids);
+      if (e < n->ide) split(n, e - n->ids);
+      switch (mode) {
+        case 0: assert(n->state == 0); n->state = 1; break;
+        case 1: assert(n->state == 1); n->state = 0; break;
+        case 2: assert(n->state >= 1); n->state += 1; n->ever = true; break;
+        case 3: assert(n->state >= 2); n->state -= 1; break;
+      }
+      fix_path(n);
+      lv = n->ide;
+    }
+  }
+
+  void advance_by_range(Span rng) {
+    i64 start = rng.start, end = rng.end;
+    while (start < end) {
+      QueryRes q = index_query(start);
+      i64 take = std::min(q.total - q.offset, end - start);
+      i64 lo, hi;
+      rr_sub(q.t0, q.t1, q.fwd, q.offset, q.offset + take, lo, hi);
+      toggle_items(lo, hi, q.kind == INS ? 0 : 2);
+      start += take;
+    }
+  }
+
+  void retreat_by_range(Span rng) {
+    i64 start = rng.start, end = rng.end;
+    while (start < end) {
+      i64 req = end - 1;
+      QueryRes q = index_query(req);
+      i64 chunk_start = req - q.offset;
+      i64 s = std::max(start, chunk_start);
+      i64 e = std::min(end, chunk_start + q.total);
+      i64 o0 = s - chunk_start;
+      i64 lo, hi;
+      rr_sub(q.t0, q.t1, q.fwd, o0, o0 + (e - s), lo, hi);
+      toggle_items(lo, hi, q.kind == INS ? 1 : 3);
+      end -= e - s;
+    }
+  }
+};
+
+// ---------------------------------------------------------------- walker
+
+struct VisitEntry {
+  Span span;
+  std::vector<i64> parents;
+  std::vector<int> parent_idxs, child_idxs;
+  bool visited = false;
+};
+
+struct Walker {
+  const Graph& g;
+  std::vector<i64> frontier;
+  std::vector<VisitEntry> input;
+  std::vector<int> to_process;
+
+  Walker(const Graph& graph, const std::vector<Span>& rev_spans,
+         std::vector<i64> start_at)
+      : g(graph), frontier(std::move(start_at)) {
+    auto find_entry_idx = [&](i64 t) -> int {
+      int lo = 0, hi = (int)input.size();
+      while (lo < hi) {
+        int mid = (lo + hi) / 2;
+        if (t < input[mid].span.start) hi = mid;
+        else if (t >= input[mid].span.end) lo = mid + 1;
+        else return mid;
+      }
+      return -1;
+    };
+    for (auto it = rev_spans.rbegin(); it != rev_spans.rend(); ++it) {
+      i64 start = it->start, end = it->end;
+      size_t i = g.find_idx(start);
+      while (start < end) {
+        i64 t_end = std::min(g.ends[i], end);
+        VisitEntry e;
+        e.span = {start, t_end};
+        g.parents_at(start, e.parents);
+        for (i64 p : e.parents) {
+          int pi = find_entry_idx(p);
+          if (pi >= 0) e.parent_idxs.push_back(pi);
+        }
+        if (e.parent_idxs.empty()) to_process.push_back((int)input.size());
+        input.push_back(std::move(e));
+        start = t_end;
+        i++;
+      }
+    }
+    for (int i = 0; i < (int)input.size(); i++)
+      for (int p : input[i].parent_idxs) input[p].child_idxs.push_back(i);
+    std::reverse(to_process.begin(), to_process.end());
+  }
+
+  // returns false when done
+  bool next(std::vector<Span>& retreat, std::vector<Span>& advance_rev,
+            Span& consume) {
+    if (to_process.empty()) return false;
+    int idx = to_process.back();
+    if (input[idx].parents.size() >= 2) {
+      int found = -1;
+      for (int ii = (int)to_process.size() - 1; ii >= 0; ii--) {
+        if (input[to_process[ii]].parents.size() < 2) { found = ii; break; }
+      }
+      if (found >= 0) {
+        idx = to_process[found];
+        to_process[found] = to_process.back();
+        to_process.pop_back();
+      } else to_process.pop_back();
+    } else to_process.pop_back();
+
+    VisitEntry& e = input[idx];
+    e.visited = true;
+
+    g.diff_rev(frontier, e.parents, retreat, advance_rev);
+    for (const Span& s : retreat) g.retreat(frontier, s);
+    for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+      g.advance(frontier, *it);
+    g.advance_known_run(frontier, e.parents, e.span);
+
+    for (int c : e.child_idxs) {
+      if (input[c].visited) continue;
+      bool ok = true;
+      for (int p : input[c].parent_idxs)
+        if (!input[p].visited) { ok = false; break; }
+      if (ok) to_process.push_back(c);
+    }
+    consume = e.span;
+    return true;
+  }
+};
+
+// ---------------------------------------------------------------- context
+
+struct XfOp { i64 lv; i64 len; u8 kind; u8 fwd; i64 pos; };  // pos=-1 => gone
+
+// Chunked int32 text buffer (the native rope; mirrors
+// diamond_types_tpu/utils/rope.py).
+struct TextBuf {
+  static const size_t TARGET = 2048;
+  std::vector<std::vector<int32_t>> chunks;
+  std::vector<i64> cum;  // chars before chunk i; size chunks.size()+1
+  bool dirty = true;
+  i64 total = 0;
+
+  TextBuf() { chunks.emplace_back(); }
+
+  void rebuild() {
+    cum.resize(chunks.size() + 1);
+    cum[0] = 0;
+    for (size_t i = 0; i < chunks.size(); i++)
+      cum[i + 1] = cum[i] + (i64)chunks[i].size();
+    dirty = false;
+  }
+
+  std::pair<size_t, i64> find(i64 pos) {
+    if (dirty) rebuild();
+    size_t lo = 0, hi = chunks.size();
+    while (lo < hi) { size_t mid = (lo + hi) / 2;
+      if (cum[mid + 1] <= pos) lo = mid + 1; else hi = mid; }
+    if (lo >= chunks.size()) { lo = chunks.size() - 1; }
+    return {lo, pos - cum[lo]};
+  }
+
+  void insert(i64 pos, const int32_t* s, i64 n) {
+    if (n <= 0) return;
+    auto [ci, off] = find(pos);
+    auto& ch = chunks[ci];
+    ch.insert(ch.begin() + off, s, s + n);
+    total += n;
+    if (ch.size() > 2 * TARGET) {
+      // split into TARGET-sized chunks
+      std::vector<std::vector<int32_t>> parts;
+      for (size_t i = 0; i < ch.size(); i += TARGET)
+        parts.emplace_back(ch.begin() + i,
+                           ch.begin() + std::min(ch.size(), i + TARGET));
+      chunks.erase(chunks.begin() + ci);
+      chunks.insert(chunks.begin() + ci, parts.begin(), parts.end());
+    }
+    dirty = true;
+  }
+
+  void erase(i64 pos, i64 n) {
+    if (n <= 0) return;
+    total -= n;
+    auto [ci, off] = find(pos);
+    while (n > 0) {
+      auto& ch = chunks[ci];
+      i64 take = std::min((i64)ch.size() - off, n);
+      ch.erase(ch.begin() + off, ch.begin() + off + take);
+      n -= take;
+      if (ch.empty() && chunks.size() > 1) chunks.erase(chunks.begin() + ci);
+      else ci++;
+      off = 0;
+    }
+    dirty = true;
+  }
+
+  void dump(int32_t* out) const {
+    i64 k = 0;
+    for (const auto& ch : chunks) {
+      std::memcpy(out + k, ch.data(), ch.size() * sizeof(int32_t));
+      k += ch.size();
+    }
+  }
+};
+
+struct Ctx {
+  Graph g;
+  Agents aa;
+  Ops ops;
+  std::vector<int32_t> ins_arena;
+  TextBuf doc;
+  std::vector<i64> version;
+  std::vector<XfOp> out;
+  std::vector<i64> out_frontier;
+};
+
+static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
+                           bool emit) {
+  Ops& ops = c->ops;
+  if (span_empty(consume)) return;
+  size_t i = ops.find_idx(consume.start);
+  i64 pos = consume.start;
+  while (pos < consume.end) {
+    const OpRun& run = ops.runs[i];
+    i64 run_end = run.lv + (run.end - run.start);
+    i64 o0 = pos - run.lv;
+    i64 o1 = std::min(consume.end, run_end) - run.lv;
+    OpRun piece = Ops::slice(run, o0, o1);
+    // apply in chunks bounded by agent runs
+    while (true) {
+      i64 plen = piece.end - piece.start;
+      i64 agent, seq;
+      c->aa.local_to_agent(piece.lv, agent, seq);
+      i64 alen = c->aa.span_len(piece.lv, plen);
+      auto [consumed, xf] = tracker.apply(c->aa, agent, piece, alen);
+      if (emit)
+        c->out.push_back({piece.lv, consumed, piece.kind, piece.fwd, xf});
+      if (consumed == plen) break;
+      piece = Ops::slice(piece, consumed, plen);
+    }
+    pos = run.lv + o1;
+    i++;
+  }
+}
+
+static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
+  c->out.clear();
+  std::vector<Span> new_ops, conflict_ops;
+  std::vector<i64> common = c->g.find_conflicting(
+      from, merge, [&](Span s, u8 flag) {
+        push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
+      });
+
+  std::vector<i64> next_frontier = from;
+  bool did_ff = false;
+
+  // FF mode
+  std::vector<i64> ps;
+  while (!new_ops.empty()) {
+    Span span = new_ops.back();
+    size_t i = c->g.find_idx(span.start);
+    c->g.parents_at(span.start, ps);
+    if (ps != next_frontier) break;
+    new_ops.pop_back();
+    i64 take_end = std::min(c->g.ends[i], span.end);
+    if (take_end < span.end) new_ops.push_back({take_end, span.end});
+    next_frontier.assign(1, take_end - 1);
+    did_ff = true;
+    // emit untransformed
+    Ops& ops = c->ops;
+    size_t oi = ops.find_idx(span.start);
+    i64 pos = span.start;
+    while (pos < take_end) {
+      const OpRun& run = ops.runs[oi];
+      i64 run_end = run.lv + (run.end - run.start);
+      i64 o1 = std::min(take_end, run_end) - run.lv;
+      OpRun piece = Ops::slice(run, pos - run.lv, o1);
+      c->out.push_back({piece.lv, piece.end - piece.start, piece.kind,
+                        piece.fwd, piece.start});
+      pos = run.lv + o1;
+      oi++;
+    }
+  }
+
+  if (!new_ops.empty()) {
+    if (did_ff) {
+      conflict_ops.clear();
+      common = c->g.find_conflicting(
+          next_frontier, merge, [&](Span s, u8 flag) {
+            if (flag != Graph::OnlyB) push_reversed_rle(conflict_ops, s);
+          });
+    }
+
+    Tracker tracker;
+    // build tracker over conflict set
+    {
+      Walker w(c->g, conflict_ops, common);
+      std::vector<Span> retreat, advance_rev;
+      Span consume;
+      while (w.next(retreat, advance_rev, consume)) {
+        for (const Span& s : retreat) tracker.retreat_by_range(s);
+        for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+          tracker.advance_by_range(*it);
+        emit_ops_range(c, tracker, consume, false);
+      }
+      // walk new ops
+      Walker w2(c->g, new_ops, w.frontier);
+      while (w2.next(retreat, advance_rev, consume)) {
+        for (const Span& s : retreat) tracker.retreat_by_range(s);
+        for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+          tracker.advance_by_range(*it);
+        c->g.advance(next_frontier, consume);
+        emit_ops_range(c, tracker, consume, true);
+      }
+    }
+  }
+  c->out_frontier = next_frontier;
+}
+
+// ---------------------------------------------------------------- C ABI
+
+extern "C" {
+
+void* dt_ctx_new() { return new Ctx(); }
+void dt_ctx_free(void* p) { delete (Ctx*)p; }
+
+void dt_add_agent(void* p, const char* name) {
+  Ctx* c = (Ctx*)p;
+  c->aa.names.emplace_back(name);
+  c->aa.client_runs.emplace_back();
+}
+
+// bulk loads (columnar)
+void dt_load_graph(void* p, i64 n, const i64* starts, const i64* ends,
+                   const i64* shadows, const i64* pindptr, const i64* pflat) {
+  Ctx* c = (Ctx*)p;
+  c->g.starts.assign(starts, starts + n);
+  c->g.ends.assign(ends, ends + n);
+  c->g.shadows.assign(shadows, shadows + n);
+  c->g.parents.resize(n);
+  for (i64 i = 0; i < n; i++)
+    c->g.parents[i].assign(pflat + pindptr[i], pflat + pindptr[i + 1]);
+}
+
+void dt_load_agent_runs(void* p, i64 n, const i64* lv0, const i64* lv1,
+                        const i64* agent, const i64* seq0) {
+  Ctx* c = (Ctx*)p;
+  c->aa.global_runs.clear();
+  for (i64 i = 0; i < n; i++) {
+    c->aa.global_runs.push_back({lv0[i], lv1[i], agent[i], seq0[i]});
+    c->aa.client_runs[agent[i]].push_back(
+        {seq0[i], seq0[i] + (lv1[i] - lv0[i]), lv0[i]});
+  }
+  for (auto& runs : c->aa.client_runs)
+    std::sort(runs.begin(), runs.end(),
+              [](const AgentRun& a, const AgentRun& b) {
+                return a.seq_start < b.seq_start;
+              });
+}
+
+void dt_load_ops(void* p, i64 n, const i64* lv, const u8* kind,
+                 const u8* fwd, const i64* start, const i64* end,
+                 const i64* cp) {
+  Ctx* c = (Ctx*)p;
+  c->ops.runs.clear();
+  c->ops.runs.reserve(n);
+  for (i64 i = 0; i < n; i++)
+    c->ops.runs.push_back({lv[i], kind[i], fwd[i], start[i], end[i], cp[i]});
+}
+
+void dt_load_ins_arena(void* p, i64 n, const int32_t* chars) {
+  Ctx* c = (Ctx*)p;
+  c->ins_arena.assign(chars, chars + n);
+}
+
+// transform: fills internal out buffer; returns count
+i64 dt_transform(void* p, const i64* from, i64 nf, const i64* merge, i64 nm) {
+  Ctx* c = (Ctx*)p;
+  transform(c, std::vector<i64>(from, from + nf),
+            std::vector<i64>(merge, merge + nm));
+  return (i64)c->out.size();
+}
+
+// Full native merge: transform + materialize into the ctx's doc buffer.
+// init (may be null/0) seeds the document. Returns final doc length.
+i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
+                      const i64* from, i64 nf, const i64* merge, i64 nm) {
+  Ctx* c = (Ctx*)p;
+  c->doc = TextBuf();
+  if (init_len > 0) c->doc.insert(0, init, init_len);
+  transform(c, std::vector<i64>(from, from + nf),
+            std::vector<i64>(merge, merge + nm));
+  for (const XfOp& x : c->out) {
+    if (x.pos < 0) continue;
+    if (x.kind == INS) {
+      // content chars for [lv, lv+len): arena offset via the op run's cp
+      const OpRun& run = c->ops.runs[c->ops.find_idx(x.lv)];
+      i64 cp = run.cp + (x.lv - run.lv);
+      c->doc.insert(x.pos, c->ins_arena.data() + cp, x.len);
+    } else {
+      c->doc.erase(x.pos, x.len);
+    }
+  }
+  return c->doc.total;
+}
+
+void dt_get_doc(void* p, int32_t* out) { ((Ctx*)p)->doc.dump(out); }
+
+void dt_get_out(void* p, i64* lv, i64* len, u8* kind, u8* fwd, i64* pos) {
+  Ctx* c = (Ctx*)p;
+  for (size_t i = 0; i < c->out.size(); i++) {
+    lv[i] = c->out[i].lv;
+    len[i] = c->out[i].len;
+    kind[i] = c->out[i].kind;
+    fwd[i] = c->out[i].fwd;
+    pos[i] = c->out[i].pos;
+  }
+}
+
+i64 dt_get_out_frontier(void* p, i64* buf, i64 cap) {
+  Ctx* c = (Ctx*)p;
+  i64 n = std::min((i64)c->out_frontier.size(), cap);
+  for (i64 i = 0; i < n; i++) buf[i] = c->out_frontier[i];
+  return (i64)c->out_frontier.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,45 @@
+"""Cross-engine differential tests: native (C++) vs Python merge engine
+(mirrors the reference's listmerge vs listmerge2 differential testing,
+SURVEY.md §4.6)."""
+
+import os
+import random
+
+import pytest
+
+from diamond_types_tpu.native import native_available
+from tests.test_encode import build_random_oplog
+
+
+@pytest.mark.skipif(not native_available(), reason="native core not built")
+@pytest.mark.parametrize("seed", range(20))
+def test_native_matches_python_engine(seed):
+    ol = build_random_oplog(seed, steps=50)
+    os.environ["DT_TPU_NO_NATIVE"] = "1"
+    try:
+        py = ol.checkout_tip()
+    finally:
+        del os.environ["DT_TPU_NO_NATIVE"]
+    nat = ol.checkout_tip()
+    assert py.snapshot() == nat.snapshot()
+    assert py.version == nat.version
+
+
+@pytest.mark.skipif(not native_available(), reason="native core not built")
+@pytest.mark.parametrize("seed", range(8))
+def test_native_incremental_merge_matches(seed):
+    rng = random.Random(seed)
+    ol = build_random_oplog(seed, steps=30)
+    # Merge from a random mid version rather than root.
+    mid = sorted(rng.sample(range(len(ol)), 2))
+    mid = ol.cg.graph.find_dominators(mid)
+    os.environ["DT_TPU_NO_NATIVE"] = "1"
+    try:
+        b1 = ol.checkout(mid)
+        b1.merge(ol, ol.version)
+    finally:
+        del os.environ["DT_TPU_NO_NATIVE"]
+    b2 = ol.checkout(mid)
+    b2.merge(ol, ol.version)
+    assert b1.snapshot() == b2.snapshot()
+    assert b1.version == b2.version
