@@ -1,9 +1,6 @@
 """Device tier: JAX/XLA kernels.
 
-LVs are int64 (documents can exceed 2^31 ops; underwater sentinels live at
-2^62), so x64 must be on before any tracing happens.
+Device arrays use int32 LVs (a single document's op count is far below 2^31;
+the host tier keeps full int64 LV space, and sentinel ids like UNDERWATER
+never ship to device).
 """
-
-import jax
-
-jax.config.update("jax_enable_x64", True)

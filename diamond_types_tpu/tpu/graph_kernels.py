@@ -37,16 +37,16 @@ def pack_graph(graph) -> dict:
     n = len(starts)
     max_p = max(1, int(max((indptr[i + 1] - indptr[i] for i in range(n)),
                            default=0)))
-    plv = np.full((n, max_p), -1, dtype=np.int64)   # parent LVs
+    plv = np.full((n, max_p), -1, dtype=np.int32)   # parent LVs
     pent = np.full((n, max_p), n, dtype=np.int32)   # parent run idx (n = pad)
     for i in range(n):
         for j, p in enumerate(flat[indptr[i]:indptr[i + 1]]):
             plv[i, j] = int(p)
             pent[i, j] = graph.find_idx(int(p))
     return {
-        "starts": jnp.asarray(starts),
-        "ends": jnp.asarray(ends),
-        "parent_lv": jnp.asarray(plv),
+        "starts": jnp.asarray(starts.astype(np.int32)),
+        "ends": jnp.asarray(ends.astype(np.int32)),
+        "parent_lv": jnp.asarray(plv.astype(np.int32)),
         "parent_run": jnp.asarray(pent),
         "n": n,
     }
@@ -88,8 +88,8 @@ def seed_from_frontier(packed: dict, frontier_lvs: jnp.ndarray) -> jnp.ndarray:
     n = packed["n"]
     valid = frontier_lvs >= 0
     ent = jnp.where(valid, _entry_of(starts, jnp.maximum(frontier_lvs, 0)),
-                    jnp.int64(n))
-    reach0 = jnp.full((n,), -1, dtype=jnp.int64)
+                    jnp.int32(n))
+    reach0 = jnp.full((n,), -1, dtype=jnp.int32)
     return reach0.at[ent].max(jnp.where(valid, frontier_lvs, -1), mode="drop")
 
 

@@ -41,8 +41,8 @@ def test_device_contains_matches_golden_vectors():
         g = build_graph(json.loads(hist_s))
         fn = gk.make_contains_fn(g)
         k = max(len(c["frontier"]) for c in group) or 1
-        frontiers = np.full((len(group), k), -1, dtype=np.int64)
-        targets = np.zeros((len(group),), dtype=np.int64)
+        frontiers = np.full((len(group), k), -1, dtype=np.int32)
+        targets = np.zeros((len(group),), dtype=np.int32)
         for i, c in enumerate(group):
             for j, v in enumerate(c["frontier"]):
                 frontiers[i, j] = v
@@ -60,7 +60,7 @@ def test_device_diff_matches_host():
         k = max(len(c["a"]), len(c["b"]), 1)
 
         def pad(f):
-            return jnp.asarray(np.array(f + [-1] * (k - len(f)), dtype=np.int64))
+            return jnp.asarray(np.array(f + [-1] * (k - len(f)), dtype=np.int32))
 
         ra, rb = gk.diff_masks(packed, pad(list(c["a"])), pad(list(c["b"])))
         ra, rb = np.asarray(ra), np.asarray(rb)
@@ -129,14 +129,14 @@ def test_sharded_graph_propagation():
     packed = gk.pack_graph(g)
     n = packed["n"]
     pad_to = 24
-    starts = np.full((pad_to,), 1 << 61, dtype=np.int64)
+    starts = np.full((pad_to,), 2**31 - 1, dtype=np.int32)
     starts[:n] = np.asarray(packed["starts"])
     k = packed["parent_lv"].shape[1]
-    plv = np.full((pad_to, k), -1, dtype=np.int64)
+    plv = np.full((pad_to, k), -1, dtype=np.int32)
     plv[:n] = np.asarray(packed["parent_lv"])
     prun = np.full((pad_to, k), pad_to, dtype=np.int32)
     prun[:n] = np.minimum(np.asarray(packed["parent_run"]), pad_to)
-    reach0 = np.full((pad_to,), -1, dtype=np.int64)
+    reach0 = np.full((pad_to,), -1, dtype=np.int32)
     reach0[16] = 169  # frontier at the merge tip
 
     mesh = make_mesh(8, axis="graph")
