@@ -1,0 +1,84 @@
+"""Peer sync via version summaries + binary patches (reference: SURVEY.md
+§3.5 and src/causalgraph/summary.rs)."""
+
+import random
+
+import pytest
+
+from diamond_types_tpu.causalgraph.summary import (intersect_with_flat_summary,
+                                                   intersect_with_summary,
+                                                   summarize_versions,
+                                                   summarize_versions_flat)
+from diamond_types_tpu.encoding.decode import decode_into, load_oplog
+from diamond_types_tpu.encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
+from tests.test_encode import build_random_oplog, semantic_eq
+from tests.test_fuzz import random_edit
+
+
+def test_summary_roundtrip_shape():
+    ol = build_random_oplog(3, steps=25)
+    vs = summarize_versions(ol.cg)
+    assert set(vs) <= {"alice", "bob"}
+    for ranges in vs.values():
+        for a, b in ranges:
+            assert a < b
+    common, rem = intersect_with_summary(ol.cg, vs)
+    assert rem is None
+    assert common == ol.version
+
+
+def test_summary_intersection_disjoint_agent():
+    ol = build_random_oplog(1, steps=10)
+    vs = {"zelda": [[0, 5]]}
+    common, rem = intersect_with_summary(ol.cg, vs)
+    assert common == []
+    assert rem == {"zelda": [[0, 5]]}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_full_sync_via_summary_and_patch(seed):
+    """The real protocol: B sends its summary, A computes the common version
+    and replies with a patch from there; B ingests it."""
+    rng = random.Random(seed)
+    a = build_random_oplog(seed, steps=30)
+    b = load_oplog(encode_oplog(a, ENCODE_FULL))
+
+    # A advances.
+    v, c = a.version, a.checkout_tip().snapshot()
+    for _ in range(12):
+        v, c = random_edit(rng, a, 0, v, c)
+
+    # Handshake: B -> A summary; A -> B patch since the common version.
+    vs = summarize_versions(b.cg)
+    common, remainder = intersect_with_summary(a.cg, vs)
+    assert remainder is None  # B has nothing A lacks
+    patch = encode_oplog(a, ENCODE_PATCH, from_version=common)
+    decode_into(b, patch)
+    assert semantic_eq(a, b)
+
+    # Flat summaries agree on the intersection for linear agents.
+    common2, _ = intersect_with_flat_summary(a.cg, summarize_versions_flat(b.cg))
+    assert a.cg.graph.frontier_contains_frontier(a.version, common2)
+
+
+def test_bidirectional_sync():
+    rng = random.Random(42)
+    a = build_random_oplog(100, steps=20)
+    b = load_oplog(encode_oplog(a, ENCODE_FULL))
+    # Both diverge.
+    a_alice = a.get_or_create_agent_id("alice")
+    b_bob = b.get_or_create_agent_id("bob")
+    va, ca = a.version, a.checkout_tip().snapshot()
+    vb, cb = b.version, b.checkout_tip().snapshot()
+    for _ in range(8):
+        va, ca = random_edit(rng, a, a_alice, va, ca)
+        vb, cb = random_edit(rng, b, b_bob, vb, cb)
+
+    # A -> B
+    common_ab, rem = intersect_with_summary(a.cg, summarize_versions(b.cg))
+    assert rem is not None  # B has ops A lacks
+    decode_into(b, encode_oplog(a, ENCODE_PATCH, from_version=common_ab))
+    # B -> A
+    common_ba, _ = intersect_with_summary(b.cg, summarize_versions(a.cg))
+    decode_into(a, encode_oplog(b, ENCODE_PATCH, from_version=common_ba))
+    assert semantic_eq(a, b)
