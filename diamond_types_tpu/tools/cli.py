@@ -1,0 +1,278 @@
+"""Command-line tool for .dt files.
+
+Capability mirror of the reference dt-cli (reference:
+crates/dt-cli/src/main.rs:34-166 — create/cat/log/version/set/repack,
+export.rs, git.rs git-import, dot.rs graphviz export).
+
+Usage: python -m diamond_types_tpu.tools.cli <command> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import json
+import os
+import subprocess
+import sys
+import uuid
+
+from ..encoding.decode import load_oplog
+from ..encoding.encode import ENCODE_FULL, EncodeOptions, encode_oplog
+from ..text.op import DEL, INS
+from ..text.oplog import OpLog
+
+
+def _read_oplog(path: str) -> OpLog:
+    with open(path, "rb") as f:
+        return load_oplog(f.read())
+
+
+def _write_oplog(path: str, ol: OpLog, opts: EncodeOptions = ENCODE_FULL) -> None:
+    with open(path, "wb") as f:
+        f.write(encode_oplog(ol, opts))
+
+
+def _rand_agent() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+def _apply_diff(ol: OpLog, agent: int, parents, old: str, new: str):
+    """Apply old->new as insert/delete ops (reference: dt-cli set / git.rs)."""
+    sm = difflib.SequenceMatcher(a=old, b=new, autojunk=False)
+    # Apply from the end so earlier positions stay valid.
+    version = list(parents)
+    for tag, i1, i2, j1, j2 in reversed(sm.get_opcodes()):
+        if tag == "equal":
+            continue
+        if tag in ("replace", "delete") and i2 > i1:
+            version = [ol.add_delete_at(agent, version, i1, i2, old[i1:i2])]
+        if tag in ("replace", "insert") and j2 > j1:
+            version = [ol.add_insert_at(agent, version, i1, new[j1:j2])]
+    return version
+
+
+def cmd_create(args) -> int:
+    if os.path.exists(args.filename) and not args.force:
+        print(f"{args.filename} exists (use --force)", file=sys.stderr)
+        return 1
+    ol = OpLog()
+    if args.content is not None:
+        agent = ol.get_or_create_agent_id(args.agent or _rand_agent())
+        ol.add_insert_at(agent, [], 0, args.content)
+    _write_oplog(args.filename, ol)
+    return 0
+
+
+def cmd_cat(args) -> int:
+    ol = _read_oplog(args.filename)
+    version = json.loads(args.version) if args.version else ol.version
+    out = ol.checkout(version).snapshot()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+    else:
+        sys.stdout.write(out)
+    return 0
+
+
+def cmd_log(args) -> int:
+    ol = _read_oplog(args.filename)
+    if args.history:
+        for (lv0, lv1, parents, agent, seq) in ol.cg.iter_entries():
+            name = ol.cg.agent_assignment.get_agent_name(agent)
+            print(json.dumps({"span": [lv0, lv1], "parents": list(parents),
+                              "agent": name, "seq": seq}))
+        return 0
+    if args.transformed:
+        for (span, op, content) in ol.iter_xf_operations():
+            if op is None:
+                continue
+            row = {"kind": "ins" if op.kind == INS else "del",
+                   "start": op.start, "end": op.end, "fwd": op.fwd}
+            if content is not None:
+                row["content"] = content
+            print(json.dumps(row))
+        return 0
+    for run in ol.ops.runs:
+        row = {"lv": run.lv, "kind": "ins" if run.kind == INS else "del",
+               "start": run.start, "end": run.end, "fwd": run.fwd}
+        c = ol.ops.get_run_content(run)
+        if c is not None:
+            row["content"] = c
+        print(json.dumps(row))
+    return 0
+
+
+def cmd_version(args) -> int:
+    ol = _read_oplog(args.filename)
+    print(json.dumps(ol.cg.local_to_remote_frontier(ol.version)))
+    return 0
+
+
+def cmd_set(args) -> int:
+    ol = _read_oplog(args.filename)
+    agent = ol.get_or_create_agent_id(args.agent or _rand_agent())
+    old = ol.checkout_tip().snapshot()
+    new = args.content if args.content is not None else sys.stdin.read()
+    _apply_diff(ol, agent, ol.version, old, new)
+    _write_oplog(args.filename, ol)
+    return 0
+
+
+def cmd_repack(args) -> int:
+    ol = _read_oplog(args.filename)
+    before = os.path.getsize(args.filename)
+    _write_oplog(args.filename, ol)
+    after = os.path.getsize(args.filename)
+    print(f"{before} -> {after} bytes")
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Cross-CRDT benchmark JSON export (reference: dt-cli export.rs)."""
+    ol = _read_oplog(args.filename)
+    txns = []
+    for (lv0, lv1, parents, agent, seq) in ol.cg.iter_entries():
+        name = ol.cg.agent_assignment.get_agent_name(agent)
+        patches = []
+        for piece in ol.ops.iter_range((lv0, lv1)):
+            content = ol.ops.get_run_content(piece) or ""
+            if piece.kind == INS:
+                patches.append([piece.start, 0, content])
+            else:
+                patches.append([piece.start, len(piece), ""])
+        txns.append({
+            "parents": [list(p) for p in
+                        (ol.cg.local_to_remote_frontier(list(parents)))],
+            "agent": name, "seqStart": seq, "patches": patches,
+        })
+    doc = {"kind": "concurrent", "endContent": ol.checkout_tip().snapshot(),
+           "txns": txns}
+    json.dump(doc, sys.stdout)
+    return 0
+
+
+def cmd_dot(args) -> int:
+    """Graphviz export of the causal graph (reference: dt-cli dot.rs,
+    src/causalgraph/dot.rs)."""
+    ol = _read_oplog(args.filename)
+    g = ol.cg.graph
+    print("digraph dt {")
+    print('  rankdir="BT";')
+    for i in range(len(g)):
+        label = f"{g.starts[i]}..{g.ends[i] - 1}"
+        print(f'  n{i} [label="{label}"];')
+        if not g.parents[i]:
+            print(f"  n{i} -> root;")
+        for p in g.parents[i]:
+            print(f"  n{i} -> n{g.find_idx(p)};")
+    print("}")
+    return 0
+
+
+def cmd_git_import(args) -> int:
+    """Replay a file's git history into a DT doc (reference: dt-cli git.rs):
+    each commit becomes an edit run by its author, parented on its git
+    parents' versions — reproducing real high-fanout causal DAGs."""
+    repo = args.repo or "."
+    path = args.path
+
+    log = subprocess.run(
+        ["git", "-C", repo, "log", "--follow", "--reverse",
+         "--format=%H %P", "--", path],
+        capture_output=True, text=True, check=True).stdout
+    commits = []
+    for line in log.splitlines():
+        parts = line.split()
+        commits.append((parts[0], parts[1:]))
+
+    ol = OpLog()
+    versions = {}   # commit hash -> (frontier, content)
+    known = set(h for h, _ in commits)
+    for h, parents in commits:
+        parents = [p for p in parents if p in known and p in versions]
+        author = subprocess.run(
+            ["git", "-C", repo, "show", "-s", "--format=%ae", h],
+            capture_output=True, text=True, check=True).stdout.strip()
+        blob = subprocess.run(
+            ["git", "-C", repo, "show", f"{h}:{path}"],
+            capture_output=True, text=True).stdout
+        if not parents:
+            base_frontier, base_content = [], ""
+        elif len(parents) == 1:
+            base_frontier, base_content = versions[parents[0]]
+        else:
+            merged = []
+            for p in parents:
+                merged = ol.cg.graph.version_union(merged, versions[p][0])
+            base_frontier = merged
+            base_content = ol.checkout(merged).snapshot()
+        agent = ol.get_or_create_agent_id(author or "unknown")
+        v = _apply_diff(ol, agent, base_frontier, base_content, blob)
+        versions[h] = (v if v != list(base_frontier) else base_frontier, blob)
+
+    _write_oplog(args.out, ol)
+    final = ol.checkout_tip().snapshot()
+    print(f"imported {len(commits)} commits, {len(ol)} ops -> {args.out} "
+          f"({os.path.getsize(args.out)} bytes); final doc {len(final)} chars")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dt-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("create", help="create a new .dt file")
+    c.add_argument("filename")
+    c.add_argument("--content")
+    c.add_argument("--agent")
+    c.add_argument("-f", "--force", action="store_true")
+    c.set_defaults(fn=cmd_create)
+
+    c = sub.add_parser("cat", help="print the document contents")
+    c.add_argument("filename")
+    c.add_argument("-o", "--output")
+    c.add_argument("--version", help="JSON list of LVs to check out at")
+    c.set_defaults(fn=cmd_cat)
+
+    c = sub.add_parser("log", help="print the operation log")
+    c.add_argument("filename")
+    c.add_argument("--transformed", action="store_true")
+    c.add_argument("--history", action="store_true")
+    c.set_defaults(fn=cmd_log)
+
+    c = sub.add_parser("version", help="print the current remote version")
+    c.add_argument("filename")
+    c.set_defaults(fn=cmd_version)
+
+    c = sub.add_parser("set", help="set contents (reads stdin by default)")
+    c.add_argument("filename")
+    c.add_argument("--content")
+    c.add_argument("--agent")
+    c.set_defaults(fn=cmd_set)
+
+    c = sub.add_parser("repack", help="re-encode the file compactly")
+    c.add_argument("filename")
+    c.set_defaults(fn=cmd_repack)
+
+    c = sub.add_parser("export", help="cross-CRDT benchmark JSON export")
+    c.add_argument("filename")
+    c.set_defaults(fn=cmd_export)
+
+    c = sub.add_parser("dot", help="graphviz export of the causal graph")
+    c.add_argument("filename")
+    c.set_defaults(fn=cmd_dot)
+
+    c = sub.add_parser("git-import", help="replay a file's git history")
+    c.add_argument("path", help="file path within the repo")
+    c.add_argument("--repo", help="git repo root (default .)")
+    c.add_argument("--out", required=True, help="output .dt file")
+    c.set_defaults(fn=cmd_git_import)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Collaborative document sync server + client.
+
+Capability mirror of the reference's wiki demo app (reference:
+wiki/server/server.ts:1-60 — an HTTP server holding an OpLog per document,
+exchanging patches with clients, persisting .dt files with rate-limited
+autosave; wiki/client/dt_doc.ts — the client keeping a local OpLog in sync).
+
+Protocol (JSON/binary over HTTP; the braid-stream equivalent is simple
+long-poll-free pull/push — each payload is a v1-format binary patch):
+
+  GET  /doc/{id}            -> current document text
+  GET  /doc/{id}/summary    -> version summary JSON
+  POST /doc/{id}/pull       body: client's summary JSON
+                            -> binary patch from the common version
+  POST /doc/{id}/push       body: binary patch -> {"ok": true}
+
+Run: python -m diamond_types_tpu.tools.server --port 8008 --data-dir docs/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..causalgraph.summary import intersect_with_summary, summarize_versions
+from ..encoding.decode import decode_into, load_oplog
+from ..encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
+from ..text.oplog import OpLog
+
+
+class DocStore:
+    """In-memory OpLogs with rate-limited autosave to .dt files
+    (reference: wiki/server rate-limited save + atomic replace)."""
+
+    def __init__(self, data_dir: Optional[str] = None,
+                 save_interval: float = 3.0) -> None:
+        self.data_dir = data_dir
+        self.save_interval = save_interval
+        self.docs: Dict[str, OpLog] = {}
+        self.dirty: Dict[str, float] = {}
+        self.lock = threading.Lock()
+
+    def _path(self, doc_id: str) -> Optional[str]:
+        if self.data_dir is None:
+            return None
+        return os.path.join(self.data_dir, doc_id + ".dt")
+
+    def get(self, doc_id: str) -> OpLog:
+        with self.lock:
+            ol = self.docs.get(doc_id)
+            if ol is None:
+                path = self._path(doc_id)
+                if path and os.path.exists(path):
+                    with open(path, "rb") as f:
+                        ol = load_oplog(f.read())
+                else:
+                    ol = OpLog()
+                    ol.doc_id = doc_id
+                self.docs[doc_id] = ol
+            return ol
+
+    def mark_dirty(self, doc_id: str) -> None:
+        with self.lock:
+            self.dirty.setdefault(doc_id, time.monotonic())
+
+    def flush(self, force: bool = False) -> None:
+        if self.data_dir is None:
+            return
+        os.makedirs(self.data_dir, exist_ok=True)
+        now = time.monotonic()
+        with self.lock:
+            due = [d for d, t in self.dirty.items()
+                   if force or now - t >= self.save_interval]
+            for d in due:
+                del self.dirty[d]
+        for doc_id in due:
+            ol = self.get(doc_id)
+            path = self._path(doc_id)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(encode_oplog(ol, ENCODE_FULL))
+            os.replace(tmp, path)  # atomic
+
+
+class SyncHandler(BaseHTTPRequestHandler):
+    store: DocStore = None  # class attr, set by serve()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _route(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 2 and parts[0] == "doc":
+            return parts[1], (parts[2] if len(parts) > 2 else "")
+        return None, None
+
+    def do_GET(self):
+        doc_id, action = self._route()
+        if doc_id is None:
+            return self._send(404, b"{}")
+        ol = self.store.get(doc_id)
+        if action == "":
+            text = ol.checkout_tip().snapshot()
+            return self._send(200, text.encode("utf8"),
+                              "text/plain; charset=utf-8")
+        if action == "summary":
+            return self._send(
+                200, json.dumps(summarize_versions(ol.cg)).encode("utf8"))
+        return self._send(404, b"{}")
+
+    def do_POST(self):
+        doc_id, action = self._route()
+        if doc_id is None:
+            return self._send(404, b"{}")
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        ol = self.store.get(doc_id)
+        if action == "pull":
+            summary = json.loads(body or b"{}")
+            common, _rem = intersect_with_summary(ol.cg, summary)
+            patch = encode_oplog(ol, ENCODE_PATCH, from_version=common)
+            return self._send(200, patch, "application/octet-stream")
+        if action == "push":
+            decode_into(ol, body)
+            self.store.mark_dirty(doc_id)
+            self.store.flush()
+            return self._send(200, b'{"ok": true}')
+        return self._send(404, b"{}")
+
+
+def serve(port: int = 8008, data_dir: Optional[str] = None
+          ) -> ThreadingHTTPServer:
+    store = DocStore(data_dir)
+    handler = type("Handler", (SyncHandler,), {"store": store})
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    return httpd
+
+
+class SyncClient:
+    """Client-side replica (reference: wiki/client/dt_doc.ts:40-171)."""
+
+    def __init__(self, base_url: str, doc_id: str, agent_name: str) -> None:
+        self.base = base_url.rstrip("/")
+        self.doc_id = doc_id
+        self.oplog = OpLog()
+        self.oplog.doc_id = doc_id
+        self.agent = self.oplog.get_or_create_agent_id(agent_name)
+        self.branch = self.oplog.checkout_tip()
+
+    def _url(self, action: str) -> str:
+        return f"{self.base}/doc/{self.doc_id}/{action}"
+
+    def pull(self) -> None:
+        summary = json.dumps(summarize_versions(self.oplog.cg)).encode("utf8")
+        req = urllib.request.Request(self._url("pull"), data=summary)
+        with urllib.request.urlopen(req) as r:
+            patch = r.read()
+        decode_into(self.oplog, patch)
+        self.branch.merge(self.oplog, self.oplog.version)
+
+    def push(self) -> None:
+        summary_req = urllib.request.Request(self._url("summary"))
+        with urllib.request.urlopen(summary_req) as r:
+            server_summary = json.loads(r.read())
+        common, _ = intersect_with_summary(self.oplog.cg, server_summary)
+        patch = encode_oplog(self.oplog, ENCODE_PATCH, from_version=common)
+        req = urllib.request.Request(self._url("push"), data=patch)
+        urllib.request.urlopen(req).read()
+
+    def sync(self) -> None:
+        self.push()
+        self.pull()
+
+    def insert(self, pos: int, text: str) -> None:
+        self.branch.insert(self.oplog, self.agent, pos, text)
+
+    def delete(self, start: int, end: int) -> None:
+        self.branch.delete(self.oplog, self.agent, start, end)
+
+    def text(self) -> str:
+        return self.branch.snapshot()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8008)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+    httpd = serve(args.port, args.data_dir)
+    print(f"serving on http://127.0.0.1:{args.port}")
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

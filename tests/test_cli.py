@@ -1,0 +1,71 @@
+"""CLI smoke tests (reference: crates/dt-cli)."""
+
+import json
+import subprocess
+import sys
+
+from diamond_types_tpu.tools import cli
+
+
+def run(args):
+    return cli.main(args)
+
+
+def test_cli_roundtrip(tmp_path, capsys):
+    f = str(tmp_path / "doc.dt")
+    assert run(["create", f, "--content", "hello world", "--agent", "seph"]) == 0
+    assert run(["cat", f]) == 0
+    assert capsys.readouterr().out == "hello world"
+
+    assert run(["set", f, "--content", "hello brave world", "--agent", "seph"]) == 0
+    assert run(["cat", f]) == 0
+    assert capsys.readouterr().out == "hello brave world"
+
+    assert run(["version", f]) == 0
+    ver = json.loads(capsys.readouterr().out)
+    assert ver[0][0] == "seph"
+
+    assert run(["log", f, "--history"]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows[0]["agent"] == "seph"
+
+    assert run(["repack", f]) == 0
+    capsys.readouterr()
+    assert run(["dot", f]) == 0
+    assert "digraph" in capsys.readouterr().out
+    assert run(["export", f]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["endContent"] == "hello brave world"
+
+
+def test_git_import(tmp_path, capsys):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo)] + list(args), check=True,
+                       capture_output=True,
+                       env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@x",
+                            "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@x",
+                            "PATH": "/usr/bin:/bin:/usr/local/bin",
+                            "HOME": str(tmp_path)})
+
+    git("init", "-b", "main")
+    (repo / "a.txt").write_text("one\n")
+    git("add", "a.txt")
+    git("commit", "-m", "c1")
+    (repo / "a.txt").write_text("one\ntwo\n")
+    git("commit", "-am", "c2")
+    # branch + merge to build a non-linear DAG
+    git("checkout", "-b", "side", "HEAD~1")
+    (repo / "a.txt").write_text("zero\none\n")
+    git("commit", "-am", "c3")
+    git("checkout", "main")
+    git("merge", "side", "-m", "merge")
+
+    out = str(tmp_path / "a.dt")
+    assert run(["git-import", "a.txt", "--repo", str(repo), "--out", out]) == 0
+    capsys.readouterr()
+    assert run(["cat", out]) == 0
+    text = capsys.readouterr().out
+    assert "one" in text and "two" in text and "zero" in text

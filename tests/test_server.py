@@ -1,0 +1,39 @@
+"""In-process server/client sync test (reference: wiki demo, SURVEY.md L8)."""
+
+import threading
+
+from diamond_types_tpu.tools.server import SyncClient, serve
+
+
+def test_two_clients_collaborate(tmp_path):
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        a = SyncClient(base, "note", "alice")
+        b = SyncClient(base, "note", "bob")
+
+        a.insert(0, "Hello from alice. ")
+        a.sync()
+        b.pull()
+        assert b.text() == "Hello from alice. "
+
+        # Concurrent edits.
+        b.insert(len(b.text()), "And bob!")
+        a.insert(0, ">> ")
+        a.sync()
+        b.sync()
+        a.sync()
+        assert a.text() == b.text()
+        assert "And bob!" in a.text() and ">> " in a.text()
+
+        # Server persisted a .dt file readable on its own.
+        httpd.RequestHandlerClass.store.flush(force=True)
+        from diamond_types_tpu.encoding.decode import load_oplog
+        with open(tmp_path / "note.dt", "rb") as f:
+            ol = load_oplog(f.read())
+        assert ol.checkout_tip().snapshot() == a.text()
+    finally:
+        httpd.shutdown()
