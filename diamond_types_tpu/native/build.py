@@ -17,7 +17,7 @@ def build(force: bool = False) -> str | None:
     if not force and os.path.exists(OUT) and \
             os.path.getmtime(OUT) >= os.path.getmtime(SRC):
         return OUT
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-DNDEBUG",
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC", "-DNDEBUG",
            SRC, "-o", OUT]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)

@@ -1,0 +1,46 @@
+"""Plan-based engine vs the M1 engine — the reference's cross-engine
+differential strategy (reference: src/listmerge2/test_conversion.rs)."""
+
+import pytest
+
+from diamond_types_tpu.listmerge.plan import compile_plan, merge_via_plan
+from tests.test_encode import build_random_oplog
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_plan_matches_m1_engine(seed):
+    ol = build_random_oplog(seed, steps=45)
+    m1 = ol.get_xf_operations_full([], ol.version)
+    m1_rows = [(lv, op.kind, op.start, op.end, op.fwd, pos)
+               for (lv, op, pos) in m1]
+    plan_rows, final = merge_via_plan(ol, [], ol.version)
+    plan_rows = [(lv, op.kind, op.start, op.end, op.fwd, pos)
+                 for (lv, op, pos) in plan_rows]
+    assert plan_rows == m1_rows
+    assert final == m1.next_frontier
+    assert final == ol.version
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_plan_incremental(seed):
+    ol = build_random_oplog(100 + seed, steps=35)
+    mid = ol.cg.graph.find_dominators([len(ol) // 2])
+    m1 = ol.get_xf_operations_full(mid, ol.version)
+    m1_rows = [(lv, pos) for (lv, _op, pos) in m1]
+    plan_rows, final = merge_via_plan(ol, mid, ol.version)
+    assert [(lv, pos) for (lv, _op, pos) in plan_rows] == m1_rows
+    assert final == m1.next_frontier
+
+
+def test_plan_is_static_schedule():
+    """A compiled plan can be executed repeatedly with identical results
+    (no hidden state in the schedule)."""
+    from diamond_types_tpu.listmerge.plan import execute_plan
+    ol = build_random_oplog(7, steps=40)
+    plan = compile_plan(ol.cg.graph, [], ol.version)
+    assert plan.num_ops() == len(ol)
+    r1 = [(lv, pos) for (lv, _o, pos) in
+          execute_plan(plan, ol.cg.agent_assignment, ol.ops)]
+    r2 = [(lv, pos) for (lv, _o, pos) in
+          execute_plan(plan, ol.cg.agent_assignment, ol.ops)]
+    assert r1 == r2

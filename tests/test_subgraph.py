@@ -1,0 +1,113 @@
+"""Subgraph projection vs a brute-force ancestor-closure oracle
+(reference capability: src/causalgraph/graph/subgraph.rs; random-graph test
+style from graph/random_graphs.rs)."""
+
+import random
+
+import pytest
+
+from diamond_types_tpu.causalgraph.graph import Graph
+from diamond_types_tpu.causalgraph.subgraph import (project_onto_subgraph,
+                                                    subgraph)
+
+
+def random_graph(rng, n_runs=12, max_run=4):
+    g = Graph()
+    lv = 0
+    heads = []
+    for _ in range(n_runs):
+        n = rng.randint(1, max_run)
+        if not heads or rng.random() < 0.3:
+            parents = []
+        else:
+            k = min(len(heads), 1 + (rng.random() < 0.35))
+            parents = sorted(rng.sample(heads, k))
+        g.push(parents, lv, lv + n)
+        for p in parents:
+            if p in heads:
+                heads.remove(p)
+        heads.append(lv + n - 1)
+        lv += n
+    return g, lv
+
+
+def ancestors(g, frontier):
+    """Brute-force transitive closure."""
+    out = set()
+    stack = list(frontier)
+    while stack:
+        v = stack.pop()
+        if v in out:
+            continue
+        out.add(v)
+        stack.extend(g.parents_at(v))
+    return out
+
+
+def brute_projection(g, filter_spans, frontier):
+    anc = ancestors(g, frontier)
+    in_filter = set()
+    for (a, b) in filter_spans:
+        in_filter.update(range(a, b))
+    cand = anc & in_filter
+    # dominators: v in cand with no other w in cand strictly descending from v
+    result = []
+    for v in cand:
+        if not any(w != v and g.frontier_contains_version([w], v)
+                   for w in cand):
+            result.append(v)
+    return sorted(result)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_projection_matches_bruteforce(seed):
+    rng = random.Random(seed)
+    g, n = random_graph(rng)
+    # Random filter: a few disjoint spans.
+    spans = []
+    pos = 0
+    while pos < n:
+        a = pos + rng.randint(0, 3)
+        b = a + rng.randint(1, 4)
+        if a >= n:
+            break
+        spans.append((a, min(b, n)))
+        pos = b + rng.randint(0, 2)
+    frontier = g.find_dominators(
+        sorted(rng.sample(range(n), rng.randint(1, min(3, n)))))
+    got = project_onto_subgraph(g, spans, frontier)
+    assert got == brute_projection(g, spans, frontier), (spans, frontier)
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_subgraph_parents_consistent(seed):
+    rng = random.Random(1000 + seed)
+    g, n = random_graph(rng)
+    spans = [(a, min(a + rng.randint(1, 5), n))
+             for a in sorted(rng.sample(range(n), min(3, n)))]
+    # de-overlap
+    clean = []
+    for (a, b) in spans:
+        if clean and a < clean[-1][1]:
+            a = clean[-1][1]
+        if a < b:
+            clean.append((a, b))
+    frontier = g.find_dominators(list(range(n)))  # tip of everything
+    sub, proj = subgraph(g, clean, frontier)
+
+    # Every subgraph entry's LVs must come from the filter.
+    in_filter = set()
+    for (a, b) in clean:
+        in_filter.update(range(a, b))
+    covered = set()
+    for i in range(len(sub)):
+        covered.update(range(sub.starts[i], sub.ends[i]))
+        # Parents must be filtered LVs and real ancestors.
+        for p in sub.parents[i]:
+            assert p in in_filter
+            assert g.frontier_contains_version([sub.starts[i]], p)
+    assert covered == in_filter  # everything is in the frontier's history
+
+    # The projected frontier must dominate the whole subgraph.
+    for v in covered:
+        assert sub.frontier_contains_version(proj, v)
