@@ -1,0 +1,114 @@
+"""Multi-CRDT document tests (reference: experimental OpLog/Branch in
+src/oplog.rs, src/branch.rs; SerializedOps exchange §3.5)."""
+
+import random
+
+import pytest
+
+from diamond_types_tpu.db.doc import Doc, KIND_MAP, KIND_TEXT, ROOT_CRDT
+
+
+def test_map_and_text_basic():
+    d = Doc()
+    a = d.get_or_create_agent_id("alice")
+    d.map_set(a, ROOT_CRDT, "title", ("prim", "my doc")[1])
+    d.map_set(a, ROOT_CRDT, "count", 42)
+    body = d.map_create_crdt(a, ROOT_CRDT, "body", KIND_TEXT)
+    d.text_insert(a, body, 0, "hello world")
+    d.text_delete(a, body, 5, 11)
+
+    out = d.checkout()
+    assert out["title"] == "my doc"
+    assert out["count"] == 42
+    assert out["body"] == "hello"
+
+
+def test_nested_maps():
+    d = Doc()
+    a = d.get_or_create_agent_id("alice")
+    inner = d.map_create_crdt(a, ROOT_CRDT, "meta", KIND_MAP)
+    d.map_set(a, inner, "lang", "en")
+    assert d.checkout() == {"meta": {"lang": "en"}}
+
+
+def test_register_conflict_resolution_deterministic():
+    d1 = Doc()
+    a = d1.get_or_create_agent_id("alice")
+    d1.map_set(a, ROOT_CRDT, "x", 1)
+    base = d1.version
+
+    d2 = Doc()
+    d2.merge_ops(d1.ops_since([]))
+    b = d2.get_or_create_agent_id("bob")
+
+    # Concurrent sets of the same key.
+    d1.map_set(a, ROOT_CRDT, "x", 10)
+    d2.map_set(b, ROOT_CRDT, "x", 20)
+
+    d1.merge_ops(d2.ops_since(base))
+    d2.merge_ops(d1.ops_since(base))
+
+    c1, c2 = d1.checkout(), d2.checkout()
+    assert c1["x"] == c2["x"] == 20  # bob > alice by agent-name tie-break
+    assert c1["_conflicts"]["x"] == [10]
+
+
+def test_concurrent_text_edits_converge():
+    d1 = Doc()
+    a = d1.get_or_create_agent_id("alice")
+    body = d1.map_create_crdt(a, ROOT_CRDT, "body", KIND_TEXT)
+    d1.text_insert(a, body, 0, "shared base ")
+    d2 = Doc()
+    d2.merge_ops(d1.ops_since([]))
+    b = d2.get_or_create_agent_id("bob")
+    base = d1.version
+
+    d1.text_insert(a, body, 12, "alice-bit")
+    body2 = next(iter(d2.texts))
+    d2.text_insert(b, body2, 12, "bob-bit")
+
+    d1.merge_ops(d2.ops_since(base))
+    d2.merge_ops(d1.ops_since(base))
+    t1 = d1.checkout()["body"]
+    t2 = d2.checkout()["body"]
+    assert t1 == t2
+    assert "alice-bit" in t1 and "bob-bit" in t1
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_db_fuzz_convergence(seed):
+    rng = random.Random(seed)
+    docs = []
+    for name in ("alice", "bob"):
+        d = Doc()
+        d.get_or_create_agent_id(name)
+        docs.append(d)
+    # Shared text crdt created by alice, synced to bob.
+    t = docs[0].map_create_crdt(0, ROOT_CRDT, "t", KIND_TEXT)
+    docs[1].merge_ops(docs[0].ops_since([]))
+
+    keys = ["a", "b", "c"]
+    for step in range(25):
+        di = rng.randrange(2)
+        d = docs[di]
+        agent = 0 if di == 0 else d.get_or_create_agent_id("bob")
+        choice = rng.random()
+        if choice < 0.4:
+            d.map_set(agent, ROOT_CRDT, rng.choice(keys), rng.randint(0, 99))
+        else:
+            tid = next(iter(d.texts))
+            cur = d.checkout_text(tid)
+            if cur and choice < 0.6:
+                s = rng.randrange(len(cur))
+                e = min(len(cur), s + rng.randint(1, 3))
+                d.text_delete(agent, tid, s, e)
+            else:
+                pos = rng.randint(0, len(cur))
+                d.text_insert(agent, tid, pos, rng.choice("xyz") * rng.randint(1, 3))
+        if rng.random() < 0.3:
+            docs[0].merge_ops(docs[1].ops_since([]))
+            docs[1].merge_ops(docs[0].ops_since([]))
+
+    docs[0].merge_ops(docs[1].ops_since([]))
+    docs[1].merge_ops(docs[0].ops_since([]))
+    assert docs[0].checkout() == docs[1].checkout()
