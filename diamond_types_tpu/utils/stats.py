@@ -1,0 +1,77 @@
+"""Observability: structured counters + stats dumps.
+
+Capability mirror of the reference's tracing facilities (SURVEY.md §5):
+print_stats RLE-compaction dumps (reference: src/list/oplog.rs:353-405),
+the thread-local op counters sketched in the merge hot loops (reference:
+src/listmerge/merge.rs:311-314, advance_retreat.rs:73-76), and the counting
+allocator used for peak-memory probes (reference: crates/trace-alloc).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from contextlib import contextmanager
+from typing import Dict
+
+
+class MergeCounters:
+    """Structured counters around the merge kernel."""
+
+    def __init__(self) -> None:
+        self.counts: Counter = Counter()
+        self.timings: Dict[str, float] = {}
+
+    def bump(self, name: str, n: int = 1) -> None:
+        self.counts[name] += n
+
+    @contextmanager
+    def timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings[name] = self.timings.get(name, 0.0) + \
+                (time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict:
+        return {"counts": dict(self.counts), "timings": dict(self.timings)}
+
+
+GLOBAL_COUNTERS = MergeCounters()
+
+
+def oplog_stats(oplog) -> Dict:
+    """RLE compaction ratios & size breakdown (reference: print_stats)."""
+    from ..text.op import DEL, INS
+    n_lv = len(oplog)
+    runs = len(oplog.ops.runs)
+    return {
+        "num_ops": n_lv,
+        "op_runs": runs,
+        "ops_per_run": round(n_lv / runs, 2) if runs else 0.0,
+        "graph_runs": len(oplog.cg.graph),
+        "agent_runs": len(oplog.cg.agent_assignment.global_runs),
+        "agents": len(oplog.cg.agent_assignment.agent_names),
+        "ins_arena_chars": oplog.ops.arena_len(INS),
+        "del_arena_chars": oplog.ops.arena_len(DEL),
+        "frontier_len": len(oplog.cg.version),
+    }
+
+
+def print_stats(oplog) -> None:
+    for k, v in oplog_stats(oplog).items():
+        print(f"{k}: {v}")
+
+
+def peak_memory_probe(fn, *args, **kwargs):
+    """Run fn while tracking peak Python allocation (reference: trace-alloc
+    counting allocator behind the memusage feature)."""
+    import tracemalloc
+    tracemalloc.start()
+    try:
+        result = fn(*args, **kwargs)
+        _current, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    return result, peak

@@ -1,0 +1,83 @@
+"""Auxiliary subsystems: shelf CRDT, stats/counters, stochastic summary,
+invariant checkers (SURVEY.md §5)."""
+
+import random
+
+from diamond_types_tpu.causalgraph.stochastic_summary import (
+    estimate_common_frontier, sample_versions)
+from diamond_types_tpu.db import shelf
+from diamond_types_tpu.utils.stats import oplog_stats, peak_memory_probe
+from tests.test_encode import build_random_oplog
+from tests.test_fuzz import random_edit
+
+
+def test_shelf_merge_commutative():
+    a = shelf.new_shelf({})
+    a = shelf.set_key(a, "x", 1)
+    a = shelf.set_key(a, "y", "hello")
+    b = shelf.new_shelf({})
+    b = shelf.set_key(b, "x", 2)
+    b = shelf.set_key(b, "x", 3)  # higher version for x
+
+    m1 = shelf.merge(a, b)
+    m2 = shelf.merge(b, a)
+    assert shelf.get(m1) == shelf.get(m2)
+    assert shelf.get(m1)["x"] == 3      # b wrote x twice -> higher version
+    assert shelf.get(m1)["y"] == "hello"
+
+
+def test_oplog_stats_and_memprobe():
+    ol = build_random_oplog(2, steps=30)
+    s = oplog_stats(ol)
+    assert s["num_ops"] == len(ol)
+    assert s["op_runs"] >= 1
+    assert s["ops_per_run"] >= 1
+
+    (_, peak) = peak_memory_probe(ol.checkout_tip)
+    assert peak > 0
+
+
+def test_stochastic_summary_converges():
+    rng = random.Random(0)
+    a = build_random_oplog(11, steps=30)
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.encoding.encode import ENCODE_FULL, encode_oplog
+    b = load_oplog(encode_oplog(a, ENCODE_FULL))
+    shared = a.version
+    # a advances
+    v, c = a.version, a.checkout_tip().snapshot()
+    for _ in range(10):
+        v, c = random_edit(rng, a, 0, v, c)
+
+    est = estimate_common_frontier(a.cg, b.cg, rounds=4, k=32)
+    # Estimate must be a true lower bound of the common version...
+    assert a.cg.graph.frontier_contains_frontier(shared, est)
+    # ...and with the frontier included in samples it finds it exactly.
+    assert est == shared
+
+
+def test_sample_includes_frontier():
+    ol = build_random_oplog(4, steps=10)
+    s = sample_versions(ol.cg, k=4)
+    remote_frontier = ol.cg.local_to_remote_frontier(ol.version)
+    for rv in remote_frontier:
+        assert tuple(rv) in [tuple(x) for x in s]
+
+
+def test_invariant_checkers_on_random_oplogs():
+    from diamond_types_tpu.utils.checkers import check_oplog
+    for seed in range(6):
+        ol = build_random_oplog(seed, steps=30)
+        check_oplog(ol, deep=True)
+
+
+def test_invariant_checkers_on_corpora():
+    import os
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.utils.checkers import check_oplog
+    p = "/root/reference/benchmark_data/friendsforever.dt"
+    if not os.path.exists(p):
+        return
+    with open(p, "rb") as f:
+        ol = load_oplog(f.read())
+    check_oplog(ol, deep=False)
