@@ -22,12 +22,26 @@ from .causalgraph.causal_graph import CausalGraph
 from .core.frontier import frontier_from, frontier_eq
 from .text.oplog import OpLog
 from .text.branch import Branch
-from .text.crdt import ListCRDT
+from .text.crdt import ListCRDT, merge_oplogs
 
 __version__ = "0.1.0"
 
+
+def load(data: bytes) -> OpLog:
+    """Load a v1-format (.dt) oplog."""
+    from .encoding.decode import load_oplog
+    return load_oplog(data)
+
+
+def save(oplog: OpLog, patch_since=None) -> bytes:
+    """Encode an oplog (full snapshot, or a patch since a version)."""
+    from .encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
+    if patch_since is None:
+        return encode_oplog(oplog, ENCODE_FULL)
+    return encode_oplog(oplog, ENCODE_PATCH, from_version=patch_since)
+
+
 __all__ = [
     "Graph", "ROOT", "DiffFlag", "AgentAssignment", "CausalGraph",
-    "OpLog", "Branch", "ListCRDT",
-    "frontier_from", "frontier_eq",
+    "OpLog", "Branch", "ListCRDT", "merge_oplogs", "load", "save",
 ]
