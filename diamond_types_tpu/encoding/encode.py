@@ -4,9 +4,9 @@ Capability mirror of the reference encoder (reference:
 src/list/encoding/encode_oplog.rs: `encode`, `encode_from`, EncodeOptions /
 ENCODE_FULL / ENCODE_PATCH). Ops are walked in optimized spanning-tree order
 between `from_version` and the oplog tip, renumbered densely into file order,
-and written as per-column RLE chunks. Content is stored uncompressed (the
-compressed-fields chunk is optional in the format; our decoder and the
-reference's accept both).
+and written as per-column RLE chunks. Content fields are LZ4-compressed into
+the shared compressed-fields chunk by default (compress_content=False writes
+plain content chunks; decoders accept both).
 """
 
 from __future__ import annotations
@@ -19,12 +19,14 @@ from ..listmerge.walker import SpanningTreeWalker
 from ..text.op import DEL, INS, can_append_ops, OpRun
 from ..text.oplog import OpLog
 from .crc32c import crc32c
-from .decode import (CHUNK_AGENTNAMES, CHUNK_CONTENT, CHUNK_CONTENT_IS_KNOWN,
+from .decode import (CHUNK_AGENTNAMES, CHUNK_COMPRESSED, CHUNK_CONTENT,
+                     CHUNK_CONTENT_COMPRESSED, CHUNK_CONTENT_IS_KNOWN,
                      CHUNK_CRC, CHUNK_DOCID, CHUNK_FILEINFO,
                      CHUNK_OP_PARENTS, CHUNK_OP_TYPE_AND_POSITION,
                      CHUNK_OP_VERSIONS, CHUNK_PATCH_CONTENT, CHUNK_PATCHES,
                      CHUNK_STARTBRANCH, CHUNK_USERDATA, CHUNK_VERSION,
                      DATA_PLAIN_TEXT, MAGIC, PROTOCOL_VERSION)
+from .lz4 import lz4_compress_block
 from .varint import encode_leb, encode_zigzag_old, mix_bit
 
 
@@ -34,6 +36,7 @@ class EncodeOptions:
     store_start_branch_content: bool = True
     store_inserted_content: bool = True
     store_deleted_content: bool = False
+    compress_content: bool = True
 
 
 ENCODE_FULL = EncodeOptions()
@@ -116,13 +119,18 @@ class _ContentChunk:
         else:
             self.runs.append([n, known])
 
-    def bake(self) -> Optional[bytes]:
+    def bake(self, compress_parts: Optional[List[bytes]] = None) -> Optional[bytes]:
         if not self.any:
             return None
         body = bytearray()
         body += encode_leb(0 if self.kind == INS else 1)
         text = "".join(self.content).encode("utf8")
-        body += _chunk(CHUNK_CONTENT, encode_leb(DATA_PLAIN_TEXT) + text)
+        if compress_parts is not None:
+            compress_parts.append(text)
+            body += _chunk(CHUNK_CONTENT_COMPRESSED,
+                           encode_leb(DATA_PLAIN_TEXT) + encode_leb(len(text)))
+        else:
+            body += _chunk(CHUNK_CONTENT, encode_leb(DATA_PLAIN_TEXT) + text)
         runs = bytearray()
         for n, known in self.runs:
             runs += encode_leb(mix_bit(n, known))
@@ -256,6 +264,7 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
     flush_op()
 
     # --- start branch --------------------------------------------------------
+    compress_parts: Optional[List[bytes]] = [] if opts.compress_content else None
     start_branch = bytearray()
     if from_version:
         vbuf = bytearray()
@@ -268,8 +277,14 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
         start_branch += _chunk(CHUNK_VERSION, bytes(vbuf))
         if opts.store_start_branch_content:
             content = oplog.checkout(from_version).snapshot().encode("utf8")
-            start_branch += _chunk(
-                CHUNK_CONTENT, encode_leb(DATA_PLAIN_TEXT) + content)
+            if compress_parts is not None:
+                compress_parts.append(content)
+                start_branch += _chunk(
+                    CHUNK_CONTENT_COMPRESSED,
+                    encode_leb(DATA_PLAIN_TEXT) + encode_leb(len(content)))
+            else:
+                start_branch += _chunk(
+                    CHUNK_CONTENT, encode_leb(DATA_PLAIN_TEXT) + content)
 
     # --- file info -----------------------------------------------------------
     fileinfo = bytearray()
@@ -281,21 +296,25 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
         fileinfo += _chunk(CHUNK_USERDATA, opts.user_data)
 
     # --- assemble ------------------------------------------------------------
-    result = bytearray()
-    result += MAGIC
-    result += encode_leb(PROTOCOL_VERSION)
-    result += _chunk(CHUNK_FILEINFO, bytes(fileinfo))
-    result += _chunk(CHUNK_STARTBRANCH, bytes(start_branch))
-
     patches = bytearray()
     if ins_content is not None:
-        baked = ins_content.bake()
+        baked = ins_content.bake(compress_parts)
         if baked is not None:
             patches += _chunk(CHUNK_PATCH_CONTENT, baked)
     if del_content is not None:
-        baked = del_content.bake()
+        baked = del_content.bake(compress_parts)
         if baked is not None:
             patches += _chunk(CHUNK_PATCH_CONTENT, baked)
+
+    result = bytearray()
+    result += MAGIC
+    result += encode_leb(PROTOCOL_VERSION)
+    if compress_parts:
+        blob = b"".join(compress_parts)
+        result += _chunk(CHUNK_COMPRESSED,
+                         encode_leb(len(blob)) + lz4_compress_block(blob))
+    result += _chunk(CHUNK_FILEINFO, bytes(fileinfo))
+    result += _chunk(CHUNK_STARTBRANCH, bytes(start_branch))
     patches += _chunk(CHUNK_OP_VERSIONS, bytes(agent_chunk))
     patches += _chunk(CHUNK_OP_TYPE_AND_POSITION, bytes(ops_chunk))
     patches += _chunk(CHUNK_OP_PARENTS, bytes(txns_chunk))

@@ -10,6 +10,67 @@ match offset, overlapping match copy.
 from __future__ import annotations
 
 
+def lz4_compress_block(src: bytes) -> bytes:
+    """Greedy LZ4 block compression (hash-table match finder).
+
+    Produces standard LZ4 block streams decodable by lz4_decompress_block and
+    by the reference's lz4_flex reader. Spec constraints honored: matches are
+    >= 4 bytes, offsets <= 0xFFFF, and the final 5 bytes (plus the 12-byte
+    end-of-block window) are emitted as literals.
+    """
+    n = len(src)
+    out = bytearray()
+    table: dict = {}
+    anchor = 0
+    i = 0
+    limit = n - 12  # don't start matches in the end window
+
+    def emit(lit_start: int, lit_end: int, match_off: int, match_len: int) -> None:
+        lit_len = lit_end - lit_start
+        token_lit = 15 if lit_len >= 15 else lit_len
+        if match_len >= 0:
+            ml = match_len - 4
+            token_match = 15 if ml >= 15 else ml
+        else:
+            token_match = 0
+        out.append((token_lit << 4) | token_match)
+        if lit_len >= 15:
+            rem = lit_len - 15
+            while rem >= 255:
+                out.append(255)
+                rem -= 255
+            out.append(rem)
+        out.extend(src[lit_start:lit_end])
+        if match_len >= 0:
+            out.append(match_off & 0xFF)
+            out.append(match_off >> 8)
+            if match_len - 4 >= 15:
+                rem = match_len - 4 - 15
+                while rem >= 255:
+                    out.append(255)
+                    rem -= 255
+                out.append(rem)
+
+    while i < limit:
+        key = src[i:i + 4]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand <= 0xFFFF and src[cand:cand + 4] == key:
+            # extend the match
+            m = 4
+            max_m = n - 5 - i  # keep last 5 bytes literal
+            while m < max_m and src[cand + m] == src[i + m]:
+                m += 1
+            if m >= 4:
+                emit(anchor, i, i - cand, m)
+                i += m
+                anchor = i
+                continue
+        i += 1
+    emit(anchor, n, 0, -1)  # trailing literals, no match
+    return bytes(out)
+
+
 def lz4_decompress_block(src: bytes, uncompressed_len: int) -> bytes:
     out = bytearray()
     i = 0

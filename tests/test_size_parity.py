@@ -1,0 +1,35 @@
+"""Encoded-size parity against the reference's published numbers
+(reference: BINARY.md:42-46 — automerge-perf trace: DT full snapshot 281KB,
+DT patch encoding 23KB)."""
+
+import os
+
+import pytest
+
+from diamond_types_tpu.encoding.decode import load_oplog
+from diamond_types_tpu.encoding.encode import (ENCODE_FULL, EncodeOptions,
+                                               encode_oplog)
+from diamond_types_tpu.text.trace import load_trace, replay_into_oplog
+from tests.conftest import reference_path
+
+
+@pytest.fixture(scope="module")
+def automerge_oplog():
+    p = reference_path("benchmark_data", "automerge-paper.json.gz")
+    if not os.path.exists(p):
+        pytest.skip("corpus missing")
+    return replay_into_oplog(load_trace(p)), load_trace(p)
+
+
+def test_full_snapshot_beats_reference_size(automerge_oplog):
+    ol, data = automerge_oplog
+    full = encode_oplog(ol, ENCODE_FULL)
+    assert len(full) < 281 * 1024  # reference's published full-snapshot size
+    assert load_oplog(full).checkout_tip().snapshot() == data.end_content
+
+
+def test_patch_encoding_beats_reference_size(automerge_oplog):
+    ol, _data = automerge_oplog
+    patch = encode_oplog(ol, EncodeOptions(store_inserted_content=False,
+                                           store_start_branch_content=False))
+    assert len(patch) < 23 * 1024  # reference's published patch size
