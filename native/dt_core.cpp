@@ -388,6 +388,16 @@ static inline void upd(Node* n) {
 
 static inline void fix_path(Node* n) { while (n) { upd(n); n = n->p; } }
 
+// Propagate a (cur, up) delta from a node whose own contribution changed
+// state (no structural change). Much cheaper than recomputing children.
+static inline void bump_path(Node* n, i64 dcur, i64 dup) {
+  while (n) { n->s_cur += dcur; n->s_up += dup; n = n->p; }
+}
+
+static inline void bump_path3(Node* n, i64 dlen, i64 dcur, i64 dup) {
+  while (n) { n->s_len += dlen; n->s_cur += dcur; n->s_up += dup; n = n->p; }
+}
+
 static Node* leftmost(Node* n) { while (n->l) n = n->l; return n; }
 
 static Node* succ(Node* n) {
@@ -463,7 +473,8 @@ struct Tracker {
   }
 
   void insert_leaf(Node* x) {
-    fix_path(x->p);
+    // x is attached with empty children: ancestors gain x's contribution.
+    bump_path3(x->p, x->n_len(), x->n_cur(), x->n_up());
     while (x->p && x->prio < x->p->prio) rot_up(x);
   }
 
@@ -484,7 +495,9 @@ struct Tracker {
     Node* rn = alloc(n->ids + off, n->ide, n->ids + off - 1, n->orr,
                      n->state, n->ever);
     n->ide = n->ids + off;
-    fix_path(n);
+    // n's own contribution shrank by rn's size.
+    bump_path3(n, -rn->n_len(), -rn->n_cur(), -rn->n_up());
+    upd(n);  // local recompute for n itself (its children are unchanged)
     insert_after(n, rn);
     reg(rn);
     return rn;
@@ -686,9 +699,11 @@ struct Tracker {
       if (off > 0) n = split(n, off);
       if (take < n->n_len()) split(n, take);
       i64 t0 = n->ids, t1 = n->ide;
+      i64 dcur = n->state == 1 ? -(t1 - t0) : 0;
+      i64 dup = n->ever ? 0 : -(t1 - t0);
       n->state += 1;
       n->ever = true;
-      fix_path(n);
+      bump_path(n, dcur, dup);
 
       del_rows[op.lv] = DelRow{op.lv, op.lv + take, t0, t1, fwd};
       return {take, ever_deleted ? -1 : del_start_xf};
@@ -723,13 +738,24 @@ struct Tracker {
       Node* n = ins_lookup(lv);
       if (lv > n->ids) n = split(n, lv - n->ids);
       if (e < n->ide) split(n, e - n->ids);
+      i64 len = n->n_len();
+      i64 dcur = 0, dup = 0;
       switch (mode) {
-        case 0: assert(n->state == 0); n->state = 1; break;
-        case 1: assert(n->state == 1); n->state = 0; break;
-        case 2: assert(n->state >= 1); n->state += 1; n->ever = true; break;
-        case 3: assert(n->state >= 2); n->state -= 1; break;
+        case 0: assert(n->state == 0); n->state = 1; dcur = len; break;
+        case 1: assert(n->state == 1); n->state = 0; dcur = -len; break;
+        case 2:
+          assert(n->state >= 1);
+          if (n->state == 1) dcur = -len;
+          n->state += 1;
+          if (!n->ever) { dup = -len; n->ever = true; }
+          break;
+        case 3:
+          assert(n->state >= 2);
+          n->state -= 1;
+          if (n->state == 1) dcur = len;
+          break;
       }
-      fix_path(n);
+      bump_path(n, dcur, dup);
       lv = n->ide;
     }
   }
