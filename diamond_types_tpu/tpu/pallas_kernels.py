@@ -1,0 +1,95 @@
+"""Pallas TPU kernels for the batched replay hot loop.
+
+The XLA path (tpu/batch.py) expresses one op-application as a gather + two
+selects; this module provides the same step as a hand-written Pallas kernel
+that keeps the whole document block resident in VMEM and fuses the shift /
+insert-select arithmetic into one pass per (doc-block, op) — avoiding the
+gather materialization XLA emits.
+
+Kernels run natively on TPU; tests exercise them with `interpret=True` on
+the CPU mesh (pallas_guide.md debugging convention).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces only exist on TPU-enabled builds
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _apply_op_kernel(pos_ref, dlen_ref, ilen_ref, chars_ref, doc_ref,
+                     len_ref, out_doc_ref, out_len_ref):
+    """One op applied to a [block, cap] slab of documents (all in VMEM).
+
+    out[i] = chars[i - pos]          for pos <= i < pos+ilen   (insert lane)
+           = doc[i]                  for i < pos
+           = doc[i - ilen + dlen]    for i >= pos+ilen         (tail shift)
+    """
+    doc = doc_ref[...]                      # [b, cap] int32
+    pos = pos_ref[...][:, None]             # [b, 1]
+    dlen = dlen_ref[...][:, None]
+    ilen = ilen_ref[...][:, None]
+    chars = chars_ref[...]                  # [b, max_ins]
+    cap = doc.shape[1]
+    idx = jax.lax.broadcasted_iota(jnp.int32, doc.shape, 1)
+
+    shift = ilen - dlen
+    src = jnp.where(idx < pos, idx, idx - shift)
+    gathered = jnp.take_along_axis(doc, jnp.clip(src, 0, cap - 1), axis=1)
+    ins_idx = jnp.clip(idx - pos, 0, chars.shape[1] - 1)
+    ins_vals = jnp.take_along_axis(chars, ins_idx, axis=1)
+    in_insert = (idx >= pos) & (idx < pos + ilen)
+    new_doc = jnp.where(in_insert, ins_vals, gathered)
+
+    noop = (ilen == 0) & (dlen == 0)
+    out_doc_ref[...] = jnp.where(noop, doc, new_doc)
+    out_len_ref[...] = len_ref[...] + jnp.where(noop[:, 0], 0,
+                                                (ilen - dlen)[:, 0])
+
+
+def apply_op_block(pos, dlen, ilen, chars, doc, doc_len, *,
+                   interpret: bool = False):
+    """Apply one positional op per document to a [b, cap] batch (Pallas)."""
+    b, cap = doc.shape
+    kwargs = {}
+    if not interpret and _VMEM is not None:
+        spec = pl.BlockSpec(memory_space=_VMEM)
+        kwargs = {"in_specs": [spec] * 6, "out_specs": (spec, spec)}
+    return pl.pallas_call(
+        _apply_op_kernel,
+        out_shape=(jax.ShapeDtypeStruct((b, cap), jnp.int32),
+                   jax.ShapeDtypeStruct((b,), jnp.int32)),
+        interpret=interpret,
+        **kwargs,
+    )(pos, dlen, ilen, chars, doc, doc_len)
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "interpret"))
+def replay_batch_pallas(pos, dlen, ilen, chars, cap: int,
+                        interpret: bool = False):
+    """Full batched replay with the Pallas step kernel inside lax.scan
+    (drop-in for tpu.batch.replay_batch)."""
+    b = pos.shape[0]
+    docs0 = jnp.zeros((b, cap), dtype=jnp.int32)
+    lens0 = jnp.zeros((b,), dtype=jnp.int32)
+
+    def step(carry, op):
+        docs, lens = carry
+        p, d, i, c = op
+        docs, lens = apply_op_block(p, d, i, c, docs, lens,
+                                    interpret=interpret)
+        return (docs, lens), None
+
+    ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
+           jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
+    (docs, lens), _ = jax.lax.scan(step, (docs0, lens0), ops)
+    return docs, lens

@@ -81,3 +81,33 @@ def test_invariant_checkers_on_corpora():
     with open(p, "rb") as f:
         ol = load_oplog(f.read())
     check_oplog(ol, deep=False)
+
+
+def test_wchar_conversions():
+    from diamond_types_tpu.core.unicount import (chars_to_wchars, count_utf16,
+                                                 wchars_to_chars)
+    s = "a\U0001F600b\U0001F3F4c"  # astral chars take 2 UTF-16 units
+    assert count_utf16(s) == 7
+    assert chars_to_wchars(s, 0) == 0
+    assert chars_to_wchars(s, 2) == 3
+    assert chars_to_wchars(s, 5) == 7
+    assert wchars_to_chars(s, 3) == 2
+    assert wchars_to_chars(s, 7) == 5
+    import pytest
+    with pytest.raises(ValueError):
+        wchars_to_chars(s, 2)  # inside the surrogate pair
+
+
+def test_branch_wchar_edits():
+    from diamond_types_tpu import OpLog
+    from diamond_types_tpu.text.branch import Branch
+
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("a")
+    b = Branch()
+    b.insert(ol, a, 0, "x\U0001F600y")
+    b.insert_at_wchar(ol, a, 3, "!")   # after the emoji (2 units) + x
+    assert b.snapshot() == "x\U0001F600!y"
+    b.delete_at_wchar(ol, a, 1, 3)     # delete the emoji
+    assert b.snapshot() == "x!y"
+    assert ol.checkout_tip().snapshot() == b.snapshot()

@@ -145,3 +145,19 @@ def test_sharded_graph_propagation():
         jnp.asarray(reach0)))
     # Every root run must be fully covered.
     assert all(reach[i] == i * 10 + 9 for i in range(16)), reach[:17]
+
+
+def test_pallas_replay_matches_xla_path():
+    """Pallas step kernel (interpret mode on CPU) vs the XLA replay path."""
+    from diamond_types_tpu.tpu.pallas_kernels import replay_batch_pallas
+    txns = [[(0, 0, "hello world")], [(5, 6, "")], [(5, 0, ", there")],
+            [(0, 1, "H")], [(12, 0, "!")]]
+    pos, dl, il, chars = encode_trace_ops(txns, max_ins=16)
+    b = 4
+    args = (jnp.asarray(np.tile(pos, (b, 1))), jnp.asarray(np.tile(dl, (b, 1))),
+            jnp.asarray(np.tile(il, (b, 1))),
+            jnp.asarray(np.tile(chars, (b, 1, 1))))
+    ref_docs, ref_lens = replay_batch(*args, cap=64)
+    docs, lens = replay_batch_pallas(*args, cap=64, interpret=True)
+    assert np.array_equal(np.asarray(docs), np.asarray(ref_docs))
+    assert np.array_equal(np.asarray(lens), np.asarray(ref_lens))
