@@ -88,6 +88,24 @@ def bench_tpu_batch(batch: int = 1024, n_ops: int = 256, cap: int = 1024,
     return None
 
 
+def bench_linear_replay():
+    """BASELINE config 1: automerge-paper linear single-branch replay."""
+    from diamond_types_tpu.text.trace import load_trace, replay_into_oplog
+    data = load_trace(os.path.join(BENCH_DATA, "automerge-paper.json.gz"))
+    t0 = time.perf_counter()
+    ol = replay_into_oplog(data)
+    t_apply = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    b = ol.checkout_tip()
+    t_checkout = time.perf_counter() - t0
+    n = data.num_ops()
+    return {
+        "apply_ops_per_sec": round(n / t_apply),
+        "checkout_ops_per_sec": round(n / t_checkout),
+        "parity": b.snapshot() == data.end_content,
+    }
+
+
 def main() -> None:
     n_ops, best, _snap = bench_merge("git-makefile.dt")
     ops_per_sec = n_ops / best
@@ -104,6 +122,17 @@ def main() -> None:
         extra["friendsforever_parity"] = parity
     except Exception as e:  # pragma: no cover
         extra["friendsforever_error"] = str(e)[:100]
+
+    try:
+        nn_ops, nn_t, _ = bench_merge("node_nodecc.dt", repeats=2)
+        extra["node_nodecc_ops_per_sec"] = round(nn_ops / nn_t)
+    except Exception as e:  # pragma: no cover
+        extra["node_nodecc_error"] = str(e)[:100]
+
+    try:
+        extra["automerge_linear"] = bench_linear_replay()
+    except Exception as e:  # pragma: no cover
+        extra["automerge_error"] = str(e)[:100]
 
     tpu = bench_tpu_batch()
     if tpu is not None:
