@@ -1,0 +1,68 @@
+// Standalone profiling harness for dt_core: loads columnar dumps produced by
+// tools/dump_columns.py and runs the transform repeatedly (for gprof).
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+#include <cstdint>
+typedef int64_t i64;
+typedef uint8_t u8;
+
+extern "C" {
+void* dt_ctx_new();
+void dt_add_agent(void*, const char*);
+void dt_load_graph(void*, i64, const i64*, const i64*, const i64*, const i64*, const i64*);
+void dt_load_agent_runs(void*, i64, const i64*, const i64*, const i64*, const i64*);
+void dt_load_ops(void*, i64, const i64*, const u8*, const u8*, const i64*, const i64*, const i64*);
+i64 dt_transform(void*, const i64*, i64, const i64*, i64);
+}
+
+template <class T>
+std::vector<T> read_vec(FILE* f) {
+  i64 n;
+  if (fread(&n, 8, 1, f) != 1) { fprintf(stderr, "bad file\n"); exit(1); }
+  std::vector<T> v(n);
+  if (n && fread(v.data(), sizeof(T), n, f) != (size_t)n) exit(1);
+  return v;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) { fprintf(stderr, "usage: %s dump.bin [iters]\n", argv[0]); return 1; }
+  int iters = argc > 2 ? atoi(argv[2]) : 10;
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) { perror("open"); return 1; }
+  i64 n_agents;
+  fread(&n_agents, 8, 1, f);
+  void* ctx = dt_ctx_new();
+  for (i64 i = 0; i < n_agents; i++) {
+    i64 len; fread(&len, 8, 1, f);
+    std::vector<char> name(len + 1, 0);
+    fread(name.data(), 1, len, f);
+    dt_add_agent(ctx, name.data());
+  }
+  auto starts = read_vec<i64>(f);
+  auto ends = read_vec<i64>(f);
+  auto shadows = read_vec<i64>(f);
+  auto indptr = read_vec<i64>(f);
+  auto flat = read_vec<i64>(f);
+  dt_load_graph(ctx, starts.size(), starts.data(), ends.data(), shadows.data(),
+                indptr.data(), flat.data());
+  auto lv0 = read_vec<i64>(f);
+  auto lv1 = read_vec<i64>(f);
+  auto ag = read_vec<i64>(f);
+  auto sq = read_vec<i64>(f);
+  dt_load_agent_runs(ctx, lv0.size(), lv0.data(), lv1.data(), ag.data(), sq.data());
+  auto olv = read_vec<i64>(f);
+  auto okind = read_vec<u8>(f);
+  auto ofwd = read_vec<u8>(f);
+  auto ost = read_vec<i64>(f);
+  auto oen = read_vec<i64>(f);
+  dt_load_ops(ctx, olv.size(), olv.data(), okind.data(), ofwd.data(),
+              ost.data(), oen.data(), ost.data() /* cp unused here */);
+  auto ver = read_vec<i64>(f);
+  fclose(f);
+  i64 total = 0;
+  for (int it = 0; it < iters; it++)
+    total += dt_transform(ctx, nullptr, 0, ver.data(), ver.size());
+  printf("transform out rows total: %lld\n", (long long)total);
+  return 0;
+}

@@ -441,7 +441,7 @@ struct Tracker {
   }
 
   Tracker() {
-    root = alloc(UNDERWATER, UNDERWATER * 2 - 1, ROOT, ROOT, 1, false);
+    root = alloc(UNDERWATER, UNDERWATER + (UNDERWATER - 1), ROOT, ROOT, 1, false);
     ins_index[root->ids] = root;
   }
   ~Tracker() { for (Node* n : pool) delete n; }
@@ -454,6 +454,40 @@ struct Tracker {
     Node* n = it->second;
     assert(n->ids <= lv && lv < n->ide);
     return n;
+  }
+
+  // Remove a node from the treap (its items now belong to a neighbor).
+  void erase_node(Node* n) {
+    while (n->l || n->r) {
+      Node* c = (!n->r || (n->l && n->l->prio < n->r->prio)) ? n->l : n->r;
+      rot_up(c);
+    }
+    Node* p = n->p;
+    if (p) {
+      if (p->l == n) p->l = nullptr; else p->r = nullptr;
+    } else {
+      root = nullptr;  // callers guarantee this can't happen (underwater)
+    }
+    n->p = nullptr;
+    bump_path3(p, -n->n_len(), -n->n_cur(), -n->n_up());
+  }
+
+  // RLE re-merge: if `n` is the linear continuation of its doc-order
+  // predecessor (same conditions as the reference's YjsSpan::can_append,
+  // yjsspan.rs:168-174), fold it in. Returns the surviving node.
+  Node* try_merge_left(Node* n) {
+    if (n->ol != n->ids - 1) return n;     // linear origin chain (cheap reject)
+    Node* p = pred(n);
+    if (!p) return n;
+    if (p->ide != n->ids) return n;        // ids must be contiguous
+    if (n->orr != p->orr) return n;
+    if (n->state != p->state || n->ever != p->ever) return n;
+    i64 dlen = n->n_len(), dcur = n->n_cur(), dup = n->n_up();
+    erase_node(n);
+    ins_index.erase(n->ids);
+    p->ide = n->ide;
+    bump_path3(p, dlen, dcur, dup);
+    return p;
   }
 
   void rot_up(Node* x) {
@@ -757,6 +791,7 @@ struct Tracker {
       }
       bump_path(n, dcur, dup);
       lv = n->ide;
+      try_merge_left(n);
     }
   }
 
