@@ -21,6 +21,7 @@
 #include <cassert>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <queue>
 #include <string>
@@ -362,289 +363,679 @@ struct Ops {
 };
 
 // ---------------------------------------------------------------- tracker
+//
+// Fat-leaf order-statistic B-tree of YjsSpan runs, the same design as the
+// reference's content-tree (crates/content-tree/src/lib.rs:64, node sizes
+// :33-41) with the dual current/upstream metric (src/listmerge/metrics.rs:
+// 18-66). The LV -> leaf "space index" (reference: src/listmerge/markers.rs
+// MarkerEntry / InsPtr) is a B+ tree of RLE runs keyed by LV, updated by a
+// notify hook when entries move between leaves.
 
-struct Node {
-  i64 ids, ide, ol, orr;
+struct BLeaf;
+
+// One YjsSpan run (reference: src/listmerge/yjsspan.rs:25-45).
+struct BEntry {
+  i64 ids;        // id (LV) of first item
+  i64 len;
+  i64 ol, orr;    // origin left / right
   int32_t state;  // 0 NIY, 1 inserted, >=2 deleted (state-1) times
   bool ever;
-  uint32_t prio;
-  Node *l = nullptr, *r = nullptr, *p = nullptr;
-  i64 s_len, s_cur, s_up;
-
-  inline i64 n_len() const { return ide - ids; }
-  inline i64 n_cur() const { return state == 1 ? ide - ids : 0; }
-  inline i64 n_up() const { return ever ? 0 : ide - ids; }
-  inline i64 origin_left_at(i64 off) const { return off == 0 ? ol : ids + off - 1; }
+  inline i64 ide() const { return ids + len; }
+  inline i64 cur() const { return state == 1 ? len : 0; }
+  inline i64 up() const { return ever ? 0 : len; }
+  inline i64 origin_left_at(i64 off) const {
+    return off == 0 ? ol : ids + off - 1;
+  }
 };
 
-static inline void upd(Node* n) {
-  i64 ln = 0, lc = 0, lu = 0, rn = 0, rc = 0, ru = 0;
-  if (n->l) { ln = n->l->s_len; lc = n->l->s_cur; lu = n->l->s_up; }
-  if (n->r) { rn = n->r->s_len; rc = n->r->s_cur; ru = n->r->s_up; }
-  n->s_len = ln + rn + n->n_len();
-  n->s_cur = lc + rc + n->n_cur();
-  n->s_up = lu + ru + n->n_up();
-}
+static const int LEAF_CAP = 32;   // entries per leaf
+static const int NODE_CAP = 16;   // children per internal node
 
-static inline void fix_path(Node* n) { while (n) { upd(n); n = n->p; } }
+struct BNode;
 
-// Propagate a (cur, up) delta from a node whose own contribution changed
-// state (no structural change). Much cheaper than recomputing children.
-static inline void bump_path(Node* n, i64 dcur, i64 dup) {
-  while (n) { n->s_cur += dcur; n->s_up += dup; n = n->p; }
-}
+struct BLeaf {
+  int n = 0;
+  BNode* parent = nullptr;
+  int pslot = 0;
+  BLeaf *next = nullptr, *prev = nullptr;
+  BEntry e[LEAF_CAP];
+};
 
-static inline void bump_path3(Node* n, i64 dlen, i64 dcur, i64 dup) {
-  while (n) { n->s_len += dlen; n->s_cur += dcur; n->s_up += dup; n = n->p; }
-}
+struct BNode {
+  int n = 0;
+  bool leaf_children = true;
+  BNode* parent = nullptr;
+  int pslot = 0;
+  void* ch[NODE_CAP];
+  i64 raw[NODE_CAP], cur[NODE_CAP], up[NODE_CAP];
+};
 
-static Node* leftmost(Node* n) { while (n->l) n = n->l; return n; }
+// ---- LV -> BLeaf* index: B+ tree of RLE runs keyed by LV ----
 
-static Node* succ(Node* n) {
-  if (n->r) return leftmost(n->r);
-  while (n->p && n == n->p->r) n = n->p;
-  return n->p;
-}
+struct IRun { i64 key, len; BLeaf* ptr; };
+static const int IL_CAP = 32;
+static const int IN_CAP = 16;
 
-static Node* pred(Node* n) {
-  if (n->l) { Node* x = n->l; while (x->r) x = x->r; return x; }
-  while (n->p && n == n->p->l) n = n->p;
-  return n->p;
-}
+struct INodeI;
+struct ILeaf {
+  int n = 0;
+  INodeI* parent = nullptr;
+  int pslot = 0;
+  ILeaf *next = nullptr, *prev = nullptr;
+  IRun r[IL_CAP];
+};
+struct INodeI {
+  int n = 0;
+  bool leaf_children = true;
+  INodeI* parent = nullptr;
+  int pslot = 0;
+  i64 k0[IN_CAP];
+  void* ch[IN_CAP];
+};
 
-struct Cursor { Node* node; i64 off; };  // node==nullptr => end of doc
+struct SpaceIndex {
+  std::deque<ILeaf> leaf_pool;
+  std::deque<INodeI> node_pool;
+  INodeI* root;
+
+  SpaceIndex() {
+    leaf_pool.emplace_back();
+    node_pool.emplace_back();
+    root = &node_pool.back();
+    root->leaf_children = true;
+    root->n = 1;
+    root->k0[0] = INT64_MIN;
+    root->ch[0] = &leaf_pool.back();
+    leaf_pool.back().parent = root;
+  }
+
+  ILeaf* descend(i64 key) const {
+    INodeI* nd = root;
+    while (true) {
+      int i = nd->n - 1;
+      while (i > 0 && nd->k0[i] > key) i--;
+      if (nd->leaf_children) {
+        ILeaf* lf = (ILeaf*)nd->ch[i];
+        // separators can be stale-low; the containing run may live in an
+        // earlier leaf (see set_range erase semantics).
+        while (lf->prev && (lf->n == 0 || key < lf->r[0].key)) lf = lf->prev;
+        return lf;
+      }
+      nd = (INodeI*)nd->ch[i];
+    }
+  }
+
+  BLeaf* query(i64 key) const {
+    ILeaf* lf = descend(key);
+    int lo = 0, hi = lf->n;
+    while (lo < hi) { int mid = (lo + hi) / 2;
+      if (lf->r[mid].key <= key) lo = mid + 1; else hi = mid; }
+    assert(lo > 0 && key < lf->r[lo - 1].key + lf->r[lo - 1].len);
+    return lf->r[lo - 1].ptr;
+  }
+
+  void split_inode(INodeI* nd) {
+    while (nd->n == IN_CAP) {
+      node_pool.emplace_back();
+      INodeI* rn = &node_pool.back();
+      int half = IN_CAP / 2;
+      rn->leaf_children = nd->leaf_children;
+      rn->n = IN_CAP - half;
+      for (int i = 0; i < rn->n; i++) {
+        rn->k0[i] = nd->k0[half + i];
+        rn->ch[i] = nd->ch[half + i];
+        if (rn->leaf_children) {
+          ((ILeaf*)rn->ch[i])->parent = rn; ((ILeaf*)rn->ch[i])->pslot = i;
+        } else {
+          ((INodeI*)rn->ch[i])->parent = rn; ((INodeI*)rn->ch[i])->pslot = i;
+        }
+      }
+      nd->n = half;
+      INodeI* par = nd->parent;
+      if (!par) {
+        node_pool.emplace_back();
+        INodeI* nr = &node_pool.back();
+        nr->leaf_children = false;
+        nr->n = 2;
+        nr->k0[0] = nd->k0[0]; nr->ch[0] = nd;
+        nr->k0[1] = rn->k0[0]; nr->ch[1] = rn;
+        nd->parent = nr; nd->pslot = 0;
+        rn->parent = nr; rn->pslot = 1;
+        root = nr;
+        return;
+      }
+      int at = nd->pslot + 1;
+      for (int i = par->n; i > at; i--) {
+        par->k0[i] = par->k0[i - 1]; par->ch[i] = par->ch[i - 1];
+        if (par->leaf_children) ((ILeaf*)par->ch[i])->pslot = i;
+        else ((INodeI*)par->ch[i])->pslot = i;
+      }
+      par->k0[at] = rn->k0[0];
+      par->ch[at] = rn;
+      rn->parent = par; rn->pslot = at;
+      par->n++;
+      nd = par;
+    }
+  }
+
+  // Insert run at position `at` in leaf lf (splitting the leaf if full).
+  void insert_run(ILeaf* lf, int at, IRun run) {
+    if (lf->n == IL_CAP) {
+      leaf_pool.emplace_back();
+      ILeaf* rn = &leaf_pool.back();
+      int half = IL_CAP / 2;
+      rn->n = IL_CAP - half;
+      std::memcpy(rn->r, lf->r + half, rn->n * sizeof(IRun));
+      lf->n = half;
+      rn->next = lf->next; if (rn->next) rn->next->prev = rn;
+      rn->prev = lf; lf->next = rn;
+      INodeI* par = lf->parent;
+      if (par->n == IN_CAP) { split_inode(par); par = lf->parent; }
+      int slot = lf->pslot + 1;
+      for (int i = par->n; i > slot; i--) {
+        par->k0[i] = par->k0[i - 1]; par->ch[i] = par->ch[i - 1];
+        ((ILeaf*)par->ch[i])->pslot = i;
+      }
+      par->k0[slot] = rn->r[0].key;
+      par->ch[slot] = rn;
+      rn->parent = par; rn->pslot = slot;
+      par->n++;
+      if (at > half) { at -= half; lf = rn; }
+    }
+    for (int i = lf->n; i > at; i--) lf->r[i] = lf->r[i - 1];
+    lf->r[at] = run;
+    lf->n++;
+  }
+
+  // Location-returning insert (position of the inserted run).
+  std::pair<ILeaf*, int> insert_run_ret(ILeaf* lf, int at, IRun run) {
+    if (lf->n == IL_CAP) {
+      // same split as insert_run, but track where `at` lands
+      insert_run(lf, at, run);
+      // find it again (rare path): run.key uniquely identifies it
+      ILeaf* l2 = lf;
+      while (l2) {
+        for (int i = 0; i < l2->n; i++)
+          if (l2->r[i].key == run.key) return {l2, i};
+        l2 = l2->next;
+      }
+      assert(false);
+      return {lf, at};
+    }
+    for (int i = lf->n; i > at; i--) lf->r[i] = lf->r[i - 1];
+    lf->r[at] = run;
+    lf->n++;
+    return {lf, at};
+  }
+
+  // Remove all coverage of [key, end). Returns the location where a run
+  // starting at `key` should be inserted to keep global key order.
+  std::pair<ILeaf*, int> erase_range(i64 key, i64 end) {
+    ILeaf* lf = descend(key);
+    int lo = 0, hi = lf->n;
+    while (lo < hi) { int mid = (lo + hi) / 2;
+      if (lf->r[mid].key <= key) lo = mid + 1; else hi = mid; }
+    int at = lo;  // first run with r.key > key
+    if (at > 0) {
+      IRun& pv = lf->r[at - 1];
+      i64 pend = pv.key + pv.len;
+      if (pend > key) {  // pv overlaps [key, ..)
+        if (pv.key == key) {
+          if (pend > end) {
+            pv.key = end; pv.len = pend - end;
+            return {lf, at - 1};
+          }
+          for (int i = at - 1; i < lf->n - 1; i++) lf->r[i] = lf->r[i + 1];
+          lf->n--; at--;
+        } else {
+          pv.len = key - pv.key;
+          if (pend > end) {
+            // hole carved in the middle of pv: keep the tail
+            return insert_run_ret(lf, at, IRun{end, pend - end, pv.ptr});
+          }
+        }
+      }
+    }
+    // remove following runs fully covered; trim a partial overlap
+    while (true) {
+      if (at == lf->n) {
+        ILeaf* nx = lf->next;
+        if (!nx) return {lf, at};
+        if (nx->n == 0) { lf = nx; at = 0; continue; }
+        if (nx->r[0].key >= end) return {lf, at};
+        lf = nx; at = 0;
+        continue;
+      }
+      IRun& r = lf->r[at];
+      if (r.key >= end) return {lf, at};
+      i64 rend = r.key + r.len;
+      if (rend <= end) {
+        for (int i = at; i < lf->n - 1; i++) lf->r[i] = lf->r[i + 1];
+        lf->n--;
+      } else {
+        r.len = rend - end;
+        r.key = end;
+        return {lf, at};
+      }
+    }
+  }
+
+  // Overwrite [key, key+len) to map to ptr.
+  void set_range(i64 key, i64 len, BLeaf* ptr) {
+    i64 end = key + len;
+    auto [lf, at] = erase_range(key, end);
+    // merge with left neighbor
+    IRun* pv = nullptr;
+    ILeaf* plf = nullptr;
+    if (at > 0) { pv = &lf->r[at - 1]; plf = lf; }
+    else if (lf->prev && lf->prev->n) {
+      plf = lf->prev; pv = &plf->r[plf->n - 1];
+    }
+    if (pv && pv->key + pv->len == key && pv->ptr == ptr) {
+      pv->len += len;
+      // absorb right neighbor too if now contiguous
+      if (at < lf->n && lf->r[at].key == end && lf->r[at].ptr == ptr &&
+          plf == lf) {
+        pv->len += lf->r[at].len;
+        for (int i = at; i < lf->n - 1; i++) lf->r[i] = lf->r[i + 1];
+        lf->n--;
+      }
+      return;
+    }
+    // merge with right neighbor
+    if (at < lf->n && lf->r[at].key == end && lf->r[at].ptr == ptr) {
+      lf->r[at].key = key; lf->r[at].len += len;
+      return;
+    }
+    insert_run(lf, at, IRun{key, len, ptr});
+  }
+};
+
+struct Cursor { BLeaf* leaf; int idx; i64 off; };  // leaf==nullptr => doc end
 
 struct DelRow { i64 lv0, lv1, t0, t1; bool fwd; };
 
 struct Tracker {
-  std::vector<Node*> pool;
-  Node* root;
-  // ins index: id_start -> node (covers underwater)
-  std::map<i64, Node*> ins_index;
+  std::deque<BLeaf> leaf_pool;
+  std::deque<BNode> node_pool;
+  BNode* root;
+  BLeaf* first_leaf;
+  SpaceIndex index;
   std::map<i64, DelRow> del_rows;  // keyed by lv0
-  uint64_t rng_state = 0x5EED5EED12345678ull;
-
-  uint32_t next_prio() {
-    rng_state ^= rng_state << 13; rng_state ^= rng_state >> 7;
-    rng_state ^= rng_state << 17;
-    return (uint32_t)rng_state;
-  }
-
-  Node* alloc(i64 ids, i64 ide, i64 ol, i64 orr, int32_t state, bool ever) {
-    Node* n = new Node();
-    n->ids = ids; n->ide = ide; n->ol = ol; n->orr = orr;
-    n->state = state; n->ever = ever;
-    n->prio = next_prio();
-    upd(n);
-    pool.push_back(n);
-    return n;
-  }
 
   Tracker() {
-    root = alloc(UNDERWATER, UNDERWATER + (UNDERWATER - 1), ROOT, ROOT, 1, false);
-    ins_index[root->ids] = root;
+    leaf_pool.emplace_back();
+    node_pool.emplace_back();
+    root = &node_pool.back();
+    first_leaf = &leaf_pool.back();
+    first_leaf->parent = root;
+    first_leaf->n = 1;
+    first_leaf->e[0] = BEntry{UNDERWATER, UNDERWATER - 1, ROOT, ROOT, 1, false};
+    root->leaf_children = true;
+    root->n = 1;
+    root->ch[0] = first_leaf;
+    root->raw[0] = UNDERWATER - 1;
+    root->cur[0] = UNDERWATER - 1;
+    root->up[0] = UNDERWATER - 1;
+    index.set_range(UNDERWATER, UNDERWATER - 1, first_leaf);
   }
-  ~Tracker() { for (Node* n : pool) delete n; }
 
-  void reg(Node* n) { ins_index[n->ids] = n; }
+  // ---- aggregate maintenance ----
 
-  Node* ins_lookup(i64 lv) const {
-    auto it = ins_index.upper_bound(lv);
-    --it;
-    Node* n = it->second;
-    assert(n->ids <= lv && lv < n->ide);
-    return n;
-  }
-
-  // Remove a node from the treap (its items now belong to a neighbor).
-  void erase_node(Node* n) {
-    while (n->l || n->r) {
-      Node* c = (!n->r || (n->l && n->l->prio < n->r->prio)) ? n->l : n->r;
-      rot_up(c);
+  static inline void bump(BLeaf* lf, i64 draw, i64 dcur, i64 dup) {
+    BNode* nd = lf->parent;
+    int slot = lf->pslot;
+    while (nd) {
+      nd->raw[slot] += draw; nd->cur[slot] += dcur; nd->up[slot] += dup;
+      slot = nd->pslot;
+      nd = nd->parent;
     }
-    Node* p = n->p;
-    if (p) {
-      if (p->l == n) p->l = nullptr; else p->r = nullptr;
-    } else {
-      root = nullptr;  // callers guarantee this can't happen (underwater)
+  }
+
+  static void leaf_totals(const BLeaf* lf, i64& raw, i64& cur, i64& up) {
+    raw = cur = up = 0;
+    for (int i = 0; i < lf->n; i++) {
+      raw += lf->e[i].len; cur += lf->e[i].cur(); up += lf->e[i].up();
     }
-    n->p = nullptr;
-    bump_path3(p, -n->n_len(), -n->n_cur(), -n->n_up());
   }
 
-  // RLE re-merge: if `n` is the linear continuation of its doc-order
-  // predecessor (same conditions as the reference's YjsSpan::can_append,
-  // yjsspan.rs:168-174), fold it in. Returns the surviving node.
-  Node* try_merge_left(Node* n) {
-    if (n->ol != n->ids - 1) return n;     // linear origin chain (cheap reject)
-    Node* p = pred(n);
-    if (!p) return n;
-    if (p->ide != n->ids) return n;        // ids must be contiguous
-    if (n->orr != p->orr) return n;
-    if (n->state != p->state || n->ever != p->ever) return n;
-    i64 dlen = n->n_len(), dcur = n->n_cur(), dup = n->n_up();
-    erase_node(n);
-    ins_index.erase(n->ids);
-    p->ide = n->ide;
-    bump_path3(p, dlen, dcur, dup);
-    return p;
-  }
+  // ---- structure mutation ----
 
-  void rot_up(Node* x) {
-    Node* p = x->p;
-    Node* g = p->p;
-    if (x == p->l) {
-      p->l = x->r; if (x->r) x->r->p = p;
-      x->r = p;
-    } else {
-      p->r = x->l; if (x->l) x->l->p = p;
-      x->l = p;
+  void split_internal(BNode* nd) {
+    while (nd->n == NODE_CAP) {
+      node_pool.emplace_back();
+      BNode* rn = &node_pool.back();
+      int half = NODE_CAP / 2;
+      rn->leaf_children = nd->leaf_children;
+      rn->n = NODE_CAP - half;
+      for (int i = 0; i < rn->n; i++) {
+        rn->ch[i] = nd->ch[half + i];
+        rn->raw[i] = nd->raw[half + i];
+        rn->cur[i] = nd->cur[half + i];
+        rn->up[i] = nd->up[half + i];
+        if (rn->leaf_children) {
+          ((BLeaf*)rn->ch[i])->parent = rn; ((BLeaf*)rn->ch[i])->pslot = i;
+        } else {
+          ((BNode*)rn->ch[i])->parent = rn; ((BNode*)rn->ch[i])->pslot = i;
+        }
+      }
+      nd->n = half;
+      i64 raw = 0, cur = 0, up = 0;
+      for (int i = 0; i < rn->n; i++) {
+        raw += rn->raw[i]; cur += rn->cur[i]; up += rn->up[i];
+      }
+      BNode* par = nd->parent;
+      if (!par) {
+        node_pool.emplace_back();
+        BNode* nr = &node_pool.back();
+        nr->leaf_children = false;
+        nr->n = 2;
+        i64 lraw = 0, lcur = 0, lup = 0;
+        for (int i = 0; i < nd->n; i++) {
+          lraw += nd->raw[i]; lcur += nd->cur[i]; lup += nd->up[i];
+        }
+        nr->ch[0] = nd; nr->raw[0] = lraw; nr->cur[0] = lcur; nr->up[0] = lup;
+        nr->ch[1] = rn; nr->raw[1] = raw; nr->cur[1] = cur; nr->up[1] = up;
+        nd->parent = nr; nd->pslot = 0;
+        rn->parent = nr; rn->pslot = 1;
+        root = nr;
+        return;
+      }
+      int at = nd->pslot + 1;
+      for (int i = par->n; i > at; i--) {
+        par->ch[i] = par->ch[i - 1];
+        par->raw[i] = par->raw[i - 1];
+        par->cur[i] = par->cur[i - 1];
+        par->up[i] = par->up[i - 1];
+        ((BNode*)par->ch[i])->pslot = i;
+      }
+      par->ch[at] = rn;
+      par->raw[at] = raw; par->cur[at] = cur; par->up[at] = up;
+      par->raw[nd->pslot] -= raw; par->cur[nd->pslot] -= cur;
+      par->up[nd->pslot] -= up;
+      rn->parent = par; rn->pslot = at;
+      par->n++;
+      nd = par;
     }
-    p->p = x; x->p = g;
-    if (g) { if (g->l == p) g->l = x; else g->r = x; }
-    else root = x;
-    upd(p); upd(x);
   }
 
-  void insert_leaf(Node* x) {
-    // x is attached with empty children: ancestors gain x's contribution.
-    bump_path3(x->p, x->n_len(), x->n_cur(), x->n_up());
-    while (x->p && x->prio < x->p->prio) rot_up(x);
-  }
-
-  void insert_after(Node* a, Node* x) {
-    if (!a->r) { a->r = x; x->p = a; }
-    else { Node* b = leftmost(a->r); b->l = x; x->p = b; }
-    insert_leaf(x);
-  }
-
-  void insert_first(Node* x) {
-    Node* b = leftmost(root);
-    b->l = x; x->p = b;
-    insert_leaf(x);
-  }
-
-  Node* split(Node* n, i64 off) {
-    assert(0 < off && off < n->n_len());
-    Node* rn = alloc(n->ids + off, n->ide, n->ids + off - 1, n->orr,
-                     n->state, n->ever);
-    n->ide = n->ids + off;
-    // n's own contribution shrank by rn's size.
-    bump_path3(n, -rn->n_len(), -rn->n_cur(), -rn->n_up());
-    upd(n);  // local recompute for n itself (its children are unchanged)
-    insert_after(n, rn);
-    reg(rn);
+  // Split a full leaf; moved entries are re-registered in the space index.
+  // Returns the new right leaf.
+  BLeaf* split_leaf(BLeaf* lf) {
+    leaf_pool.emplace_back();
+    BLeaf* rn = &leaf_pool.back();
+    int half = LEAF_CAP / 2;
+    rn->n = LEAF_CAP - half;
+    std::memcpy(rn->e, lf->e + half, rn->n * sizeof(BEntry));
+    lf->n = half;
+    rn->next = lf->next; if (rn->next) rn->next->prev = rn;
+    rn->prev = lf; lf->next = rn;
+    i64 raw, cur, up;
+    leaf_totals(rn, raw, cur, up);
+    BNode* par = lf->parent;
+    if (par->n == NODE_CAP) { split_internal(par); par = lf->parent; }
+    int at = lf->pslot + 1;
+    for (int i = par->n; i > at; i--) {
+      par->ch[i] = par->ch[i - 1];
+      par->raw[i] = par->raw[i - 1];
+      par->cur[i] = par->cur[i - 1];
+      par->up[i] = par->up[i - 1];
+      ((BLeaf*)par->ch[i])->pslot = i;
+    }
+    par->ch[at] = rn;
+    par->raw[at] = raw; par->cur[at] = cur; par->up[at] = up;
+    par->raw[lf->pslot] -= raw; par->cur[lf->pslot] -= cur;
+    par->up[lf->pslot] -= up;
+    rn->parent = par; rn->pslot = at;
+    par->n++;
+    // notify: moved entries now live in rn
+    for (int i = 0; i < rn->n; i++)
+      index.set_range(rn->e[i].ids, rn->e[i].len, rn);
     return rn;
   }
 
-  i64 prefix(const Node* n, int which) const {
-    auto sub = [&](const Node* x) -> i64 {
-      if (!x) return 0;
-      return which == 0 ? x->s_len : which == 1 ? x->s_cur : x->s_up;
-    };
-    auto own = [&](const Node* x) -> i64 {
-      return which == 0 ? x->n_len() : which == 1 ? x->n_cur() : x->n_up();
-    };
-    i64 acc = sub(n->l);
-    const Node* x = n;
-    while (x->p) {
-      if (x == x->p->r) acc += sub(x->p->l) + own(x->p);
-      x = x->p;
+  // Insert `ent` at position (lf, at); returns the entry's new location.
+  std::pair<BLeaf*, int> insert_entry(BLeaf* lf, int at, const BEntry& ent) {
+    if (lf->n == LEAF_CAP) {
+      BLeaf* rn = split_leaf(lf);
+      if (at > lf->n) { at -= lf->n; lf = rn; }
+    }
+    for (int i = lf->n; i > at; i--) lf->e[i] = lf->e[i - 1];
+    lf->e[at] = ent;
+    lf->n++;
+    bump(lf, ent.len, ent.cur(), ent.up());
+    return {lf, at};
+  }
+
+  // Split entry (lf, idx) at offset `off` (0 < off < len). Returns the
+  // location of the LEFT half; the right half sits at (leaf, idx+1) of the
+  // returned location (guaranteed same leaf).
+  std::pair<BLeaf*, int> split_entry(BLeaf* lf, int idx, i64 off) {
+    BLeaf* orig = lf;
+    BEntry right{lf->e[idx].ids + off, lf->e[idx].len - off,
+                 lf->e[idx].ids + off - 1, lf->e[idx].orr,
+                 lf->e[idx].state, lf->e[idx].ever};
+    lf->e[idx].len = off;
+    bump(lf, -right.len, -right.cur(), -right.up());
+    if (lf->n == LEAF_CAP) {
+      BLeaf* rn = split_leaf(lf);
+      if (idx >= lf->n) { idx -= lf->n; lf = rn; }
+    }
+    for (int i = lf->n; i > idx + 1; i--) lf->e[i] = lf->e[i - 1];
+    lf->e[idx + 1] = right;
+    lf->n++;
+    bump(lf, right.len, right.cur(), right.up());
+    if (lf != orig) index.set_range(right.ids, right.len, lf);
+    return {lf, idx};
+  }
+
+  // ---- lookup ----
+
+  // (leaf, idx) of the entry containing lv
+  std::pair<BLeaf*, int> ins_lookup(i64 lv) const {
+    BLeaf* lf = index.query(lv);
+    for (int i = 0; i < lf->n; i++)
+      if (lf->e[i].ids <= lv && lv < lf->e[i].ide()) return {lf, i};
+    assert(false && "ins_lookup: lv not in mapped leaf");
+    return {nullptr, 0};
+  }
+
+  Cursor find_by_cur(i64 pos) const {
+    BNode* nd = root;
+    while (true) {
+      int i = 0;
+      while (pos >= nd->cur[i]) { pos -= nd->cur[i]; i++; assert(i < nd->n); }
+      if (nd->leaf_children) {
+        BLeaf* lf = (BLeaf*)nd->ch[i];
+        for (int j = 0; j < lf->n; j++) {
+          i64 c = lf->e[j].cur();
+          if (pos < c) return {lf, j, pos};
+          pos -= c;
+        }
+        assert(false && "find_by_cur: pos out of range");
+      }
+      nd = (BNode*)nd->ch[i];
+    }
+  }
+
+  i64 prefix(const Cursor& c, int which) const {
+    // which: 0 raw, 1 cur, 2 up
+    i64 acc = 0;
+    const BLeaf* lf = c.leaf;
+    for (int i = 0; i < c.idx; i++) {
+      const BEntry& e = lf->e[i];
+      acc += which == 0 ? e.len : which == 1 ? e.cur() : e.up();
+    }
+    const BNode* nd = lf->parent;
+    int slot = lf->pslot;
+    while (nd) {
+      const i64* agg = which == 0 ? nd->raw : which == 1 ? nd->cur : nd->up;
+      for (int i = 0; i < slot; i++) acc += agg[i];
+      slot = nd->pslot;
+      nd = nd->parent;
     }
     return acc;
   }
 
-  i64 raw_pos(Cursor c) const {
-    if (!c.node) return root->s_len;
-    return prefix(c.node, 0) + c.off;
+  i64 total(int which) const {
+    const i64* agg = which == 0 ? root->raw : which == 1 ? root->cur
+                                            : root->up;
+    i64 acc = 0;
+    for (int i = 0; i < root->n; i++) acc += agg[i];
+    return acc;
   }
 
-  i64 upstream_pos(Cursor c) const {
-    if (!c.node) return root->s_up;
-    return prefix(c.node, 2) + (c.node->ever ? 0 : c.off);
+  i64 raw_pos(const Cursor& c) const {
+    if (!c.leaf) return total(0);
+    return prefix(c, 0) + c.off;
   }
 
-  Cursor find_by_cur(i64 pos) const {
-    Node* n = root;
-    assert(pos < n->s_cur);
-    while (true) {
-      i64 lc = n->l ? n->l->s_cur : 0;
-      if (pos < lc) { n = n->l; continue; }
-      pos -= lc;
-      i64 here = n->n_cur();
-      if (pos < here) return {n, pos};
-      pos -= here;
-      n = n->r;
-    }
+  i64 upstream_pos(const Cursor& c) const {
+    if (!c.leaf) return total(2);
+    return prefix(c, 2) + (c.leaf->e[c.idx].ever ? 0 : c.off);
   }
 
-  // normalize so off < len; {nullptr,0} at end of doc
+  // normalize so off < entry len; {nullptr} at end of doc
   bool roll(Cursor& c) const {
-    if (!c.node) return false;
-    while (c.off >= c.node->n_len()) {
-      Node* nx = succ(c.node);
-      if (!nx) { c.node = nullptr; c.off = 0; return false; }
-      c.node = nx; c.off = 0;
+    if (!c.leaf) return false;
+    while (c.off >= c.leaf->e[c.idx].len) {
+      c.off -= c.leaf->e[c.idx].len;
+      c.idx++;
+      while (c.idx >= c.leaf->n) {
+        if (!c.leaf->next) { c.leaf = nullptr; c.idx = 0; c.off = 0; return false; }
+        c.leaf = c.leaf->next;
+        c.idx = 0;
+      }
+    }
+    return true;
+  }
+
+  // step to the next entry (ignores off)
+  static bool next_entry(Cursor& c) {
+    c.idx++; c.off = 0;
+    while (c.idx >= c.leaf->n) {
+      if (!c.leaf->next) { c.leaf = nullptr; c.idx = 0; return false; }
+      c.leaf = c.leaf->next;
+      c.idx = 0;
     }
     return true;
   }
 
   Cursor cursor_before_item(i64 lv) const {
-    if (lv == ROOT) return {nullptr, 0};  // end sentinel
-    Node* n = ins_lookup(lv);
-    return {n, lv - n->ids};
+    if (lv == ROOT) return {nullptr, 0, 0};  // end sentinel
+    auto [lf, i] = ins_lookup(lv);
+    return {lf, i, lv - lf->e[i].ids};
   }
 
   Cursor cursor_after_item(i64 lv) const {
-    if (lv == ROOT) return {leftmost(root), 0};
-    Node* n = ins_lookup(lv);
-    Cursor c{n, lv - n->ids + 1};
+    if (lv == ROOT) {
+      BLeaf* lf = first_leaf;
+      Cursor c{lf, 0, 0};
+      roll(c);
+      return c;
+    }
+    auto [lf, i] = ins_lookup(lv);
+    Cursor c{lf, i, lv - lf->e[i].ids + 1};
     roll(c);
     return c;
   }
 
-  int cmp_cursors(Cursor a, Cursor b) const {
+  int cmp_cursors(const Cursor& a, const Cursor& b) const {
+    if (a.leaf == b.leaf) {
+      if (a.idx != b.idx) return a.idx < b.idx ? -1 : 1;
+      return a.off < b.off ? -1 : a.off > b.off ? 1 : 0;
+    }
     i64 pa = raw_pos(a), pb = raw_pos(b);
     return pa < pb ? -1 : pa > pb ? 1 : 0;
   }
 
-  void insert_at(Cursor c, Node* node) {
-    if (!c.node) {
-      Node* x = root; while (x->r) x = x->r;
-      insert_after(x, node);
-    } else if (c.off == 0) {
-      Node* pv = pred(c.node);
-      if (!pv) insert_first(node);
-      else insert_after(pv, node);
-    } else if (c.off == c.node->n_len()) {
-      insert_after(c.node, node);
+  // Try to RLE-merge entry (lf, idx) into its doc-order predecessor
+  // (reference: YjsSpan::can_append, yjsspan.rs:168-174).
+  void try_merge_left(BLeaf* lf, int idx) {
+    BEntry& en = lf->e[idx];
+    if (en.ol != en.ids - 1) return;
+    if (idx > 0) {
+      BEntry& pv = lf->e[idx - 1];
+      if (pv.ide() != en.ids || pv.orr != en.orr ||
+          pv.state != en.state || pv.ever != en.ever) return;
+      pv.len += en.len;
+      for (int i = idx; i < lf->n - 1; i++) lf->e[i] = lf->e[i + 1];
+      lf->n--;
+      // aggregates unchanged (same leaf, same totals); index unchanged.
     } else {
-      split(c.node, c.off);
-      insert_after(c.node, node);
+      BLeaf* pl = lf->prev;
+      if (!pl || pl->n == 0 || lf->n <= 1) return;  // keep leaves non-empty
+      BEntry& pv = pl->e[pl->n - 1];
+      if (pv.ide() != en.ids || pv.orr != en.orr ||
+          pv.state != en.state || pv.ever != en.ever) return;
+      i64 raw = en.len, cur = en.cur(), up = en.up();
+      pv.len += en.len;
+      index.set_range(en.ids, en.len, pl);
+      for (int i = 0; i < lf->n - 1; i++) lf->e[i] = lf->e[i + 1];
+      lf->n--;
+      bump(pl, raw, cur, up);
+      bump(lf, -raw, -cur, -up);
     }
-    reg(node);
   }
 
-  i64 integrate(const Agents& aa, i64 agent, Node* item, Cursor cursor) {
+  // Insert a new item entry at cursor position (splitting as needed).
+  // Returns nothing; caller already computed positions.
+  void insert_at(const Cursor& c, const BEntry& ent) {
+    BLeaf* lf; int at;
+    if (!c.leaf) {
+      // end of doc: append after last entry of rightmost leaf
+      BNode* nd = root;
+      while (!nd->leaf_children) nd = (BNode*)nd->ch[nd->n - 1];
+      lf = (BLeaf*)nd->ch[nd->n - 1];
+      at = lf->n;
+    } else if (c.off == 0) {
+      lf = c.leaf; at = c.idx;
+    } else if (c.off == c.leaf->e[c.idx].len) {
+      lf = c.leaf; at = c.idx + 1;
+    } else {
+      auto [l2, i2] = split_entry(c.leaf, c.idx, c.off);
+      lf = l2; at = i2 + 1;  // insert before the right half
+    }
+    // RLE append fast path: extend the left neighbor when the new item is
+    // its linear continuation.
+    BEntry* pv = nullptr;
+    BLeaf* pvleaf = nullptr;
+    if (at > 0) { pv = &lf->e[at - 1]; pvleaf = lf; }
+    else if (lf->prev && lf->prev->n) {
+      pvleaf = lf->prev; pv = &pvleaf->e[pvleaf->n - 1];
+    }
+    if (pv && ent.ol == ent.ids - 1 && pv->ide() == ent.ids &&
+        pv->orr == ent.orr && pv->state == ent.state && pv->ever == ent.ever) {
+      pv->len += ent.len;
+      bump(pvleaf, ent.len, ent.cur(), ent.up());
+      index.set_range(ent.ids, ent.len, pvleaf);
+      return;
+    }
+    auto [l3, i3] = insert_entry(lf, at, ent);
+    index.set_range(ent.ids, ent.len, l3);
+  }
+
+  i64 integrate(const Agents& aa, i64 agent, const BEntry& item,
+                Cursor cursor) {
     bool at_end = !roll(cursor);
     Cursor left_cursor = cursor;
     Cursor scan_start = cursor;
     bool scanning = false;
 
-    while (!at_end && cursor.node) {
+    while (!at_end && cursor.leaf) {
       if (!roll(cursor)) break;
-      Node* other = cursor.node;
+      const BEntry& other = cursor.leaf->e[cursor.idx];
       i64 off = cursor.off;
-      i64 other_lv = other->ids + off;
-      if (other_lv == item->orr) break;
-      assert(other->state == 0);
+      i64 other_lv = other.ids + off;
+      if (other_lv == item.orr) break;
+      assert(other.state == 0);
 
-      i64 other_left_lv = other->origin_left_at(off);
+      i64 other_left_lv = other.origin_left_at(off);
       Cursor olc = cursor_after_item(other_left_lv);
       int c = cmp_cursors(olc, left_cursor);
       if (c < 0) break;
       if (c == 0) {
-        if (item->orr == other->orr) {
+        if (item.orr == other.orr) {
           i64 oa, oseq;
           aa.local_to_agent(other_lv, oa, oseq);
           const std::string& my_name = aa.names[agent];
@@ -653,25 +1044,26 @@ struct Tracker {
           if (my_name < other_name) ins_here = true;
           else if (my_name == other_name) {
             i64 ma, mseq;
-            aa.local_to_agent(item->ids, ma, mseq);
+            aa.local_to_agent(item.ids, ma, mseq);
             ins_here = mseq < oseq;
           } else ins_here = false;
           if (ins_here) break;
           scanning = false;
         } else {
-          Cursor mr = cursor_before_item(item->orr);
-          Cursor orc = cursor_before_item(other->orr);
+          Cursor mr = cursor_before_item(item.orr);
+          Cursor orc = cursor_before_item(other.orr);
           if (cmp_cursors(orc, mr) < 0) {
             if (!scanning) { scanning = true; scan_start = cursor; }
           } else scanning = false;
         }
       }
-      Node* nx = succ(other);
-      if (!nx) { cursor = {other, other->n_len()}; break; }
-      cursor = {nx, 0};
+      if (!next_entry(cursor)) {
+        cursor = {nullptr, 0, 0};
+        break;
+      }
     }
     if (scanning) cursor = scan_start;
-    Cursor at = cursor.node ? cursor : Cursor{nullptr, 0};
+    Cursor at = cursor.leaf ? cursor : Cursor{nullptr, 0, 0};
     i64 pos = upstream_pos(at);
     insert_at(at, item);
     return pos;
@@ -687,26 +1079,25 @@ struct Tracker {
       Cursor cursor;
       if (op.start == 0) {
         origin_left = ROOT;
-        cursor = {leftmost(root), 0};
+        cursor = {first_leaf, 0, 0};
+        // first_leaf may start empty-rolled; roll handled in integrate
       } else {
         Cursor c = find_by_cur(op.start - 1);
-        origin_left = c.node->ids + c.off;
-        cursor = {c.node, c.off + 1};
+        origin_left = c.leaf->e[c.idx].ids + c.off;
+        cursor = {c.leaf, c.idx, c.off + 1};
       }
-      // origin_right: next non-NIY item
+      // origin_right: next non-NIY item at-or-after cursor
       Cursor c2 = cursor;
       i64 origin_right = ROOT;
       if (roll(c2)) {
         while (true) {
-          if (c2.node->state == 0) {
-            Node* nx = succ(c2.node);
-            if (!nx) { origin_right = ROOT; break; }
-            c2 = {nx, 0};
-          } else { origin_right = c2.node->ids + c2.off; break; }
+          const BEntry& e = c2.leaf->e[c2.idx];
+          if (e.state == 0) {
+            if (!next_entry(c2)) { origin_right = ROOT; break; }
+          } else { origin_right = e.ids + c2.off; break; }
         }
       }
-      Node* item = alloc(op.lv, op.lv + length, origin_left, origin_right,
-                         1, false);
+      BEntry item{op.lv, length, origin_left, origin_right, 1, false};
       i64 pos = integrate(aa, agent, item, cursor);
       return {length, pos};
     } else {
@@ -722,22 +1113,30 @@ struct Tracker {
         i64 entry_start_pos = last_pos - c.off;
         i64 edit_start = std::max(entry_start_pos, op.end - length);
         take_req = op.end - edit_start;
-        cursor = {c.node, c.off - (take_req - 1)};
+        cursor = {c.leaf, c.idx, c.off - (take_req - 1)};
       }
-      Node* n = cursor.node;
+      BLeaf* lf = cursor.leaf;
+      int idx = cursor.idx;
       i64 off = cursor.off;
-      assert(n->state == 1);
-      bool ever_deleted = n->ever;
+      assert(lf->e[idx].state == 1);
+      bool ever_deleted = lf->e[idx].ever;
       i64 del_start_xf = upstream_pos(cursor);
-      i64 take = std::min(take_req, n->n_len() - off);
-      if (off > 0) n = split(n, off);
-      if (take < n->n_len()) split(n, take);
-      i64 t0 = n->ids, t1 = n->ide;
-      i64 dcur = n->state == 1 ? -(t1 - t0) : 0;
-      i64 dup = n->ever ? 0 : -(t1 - t0);
-      n->state += 1;
-      n->ever = true;
-      bump_path(n, dcur, dup);
+      i64 take = std::min(take_req, lf->e[idx].len - off);
+      if (off > 0) {
+        auto [l2, i2] = split_entry(lf, idx, off);
+        lf = l2; idx = i2 + 1;  // right half
+      }
+      if (take < lf->e[idx].len) {
+        auto [l2, i2] = split_entry(lf, idx, take);
+        lf = l2; idx = i2;  // left half
+      }
+      BEntry& en = lf->e[idx];
+      i64 t0 = en.ids, t1 = en.ide();
+      i64 dcur = en.state == 1 ? -(t1 - t0) : 0;
+      i64 dup = en.ever ? 0 : -(t1 - t0);
+      en.state += 1;
+      en.ever = true;
+      bump(lf, 0, dcur, dup);
 
       del_rows[op.lv] = DelRow{op.lv, op.lv + take, t0, t1, fwd};
       return {take, ever_deleted ? -1 : del_start_xf};
@@ -755,8 +1154,9 @@ struct Tracker {
       if (r.lv0 <= lv && lv < r.lv1)
         return {DEL, r.t0, r.t1, r.fwd, lv - r.lv0, r.lv1 - r.lv0};
     }
-    Node* n = ins_lookup(lv);
-    return {INS, n->ids, n->ide, true, lv - n->ids, n->n_len()};
+    auto [lf, i] = ins_lookup(lv);
+    const BEntry& e = lf->e[i];
+    return {INS, e.ids, e.ide(), true, lv - e.ids, e.len};
   }
 
   static void rr_sub(i64 t0, i64 t1, bool fwd, i64 o0, i64 o1,
@@ -769,31 +1169,76 @@ struct Tracker {
     // modes: 0 ins, 1 unins, 2 del, 3 undel
     i64 lv = s;
     while (lv < e) {
-      Node* n = ins_lookup(lv);
-      if (lv > n->ids) n = split(n, lv - n->ids);
-      if (e < n->ide) split(n, e - n->ids);
-      i64 len = n->n_len();
+      auto [lf, idx] = ins_lookup(lv);
+      if (lv > lf->e[idx].ids) {
+        auto [l2, i2] = split_entry(lf, idx, lv - lf->e[idx].ids);
+        lf = l2; idx = i2 + 1;  // right half
+      }
+      if (e < lf->e[idx].ide()) {
+        auto [l2, i2] = split_entry(lf, idx, e - lf->e[idx].ids);
+        lf = l2; idx = i2;  // left half
+      }
+      BEntry& en = lf->e[idx];
+      i64 len = en.len;
       i64 dcur = 0, dup = 0;
       switch (mode) {
-        case 0: assert(n->state == 0); n->state = 1; dcur = len; break;
-        case 1: assert(n->state == 1); n->state = 0; dcur = -len; break;
+        case 0: assert(en.state == 0); en.state = 1; dcur = len; break;
+        case 1: assert(en.state == 1); en.state = 0; dcur = -len; break;
         case 2:
-          assert(n->state >= 1);
-          if (n->state == 1) dcur = -len;
-          n->state += 1;
-          if (!n->ever) { dup = -len; n->ever = true; }
+          assert(en.state >= 1);
+          if (en.state == 1) dcur = -len;
+          en.state += 1;
+          if (!en.ever) { dup = -len; en.ever = true; }
           break;
         case 3:
-          assert(n->state >= 2);
-          n->state -= 1;
-          if (n->state == 1) dcur = len;
+          assert(en.state >= 2);
+          en.state -= 1;
+          if (en.state == 1) dcur = len;
           break;
       }
-      bump_path(n, dcur, dup);
-      lv = n->ide;
-      try_merge_left(n);
+      bump(lf, 0, dcur, dup);
+      lv = en.ide();
+      try_merge_left(lf, idx);
     }
   }
+
+#ifdef DT_CHECK
+  // Deep invariant checker (debug builds): parent aggregates vs recomputed
+  // child totals, linked-list order, and index coverage of every entry.
+  void check_node(BNode* nd) const {
+    for (int i = 0; i < nd->n; i++) {
+      if (nd->leaf_children) {
+        BLeaf* lf = (BLeaf*)nd->ch[i];
+        assert(lf->parent == nd && lf->pslot == i);
+        assert(lf->n > 0);
+        i64 raw, cur, up;
+        leaf_totals(lf, raw, cur, up);
+        assert(nd->raw[i] == raw && nd->cur[i] == cur && nd->up[i] == up);
+      } else {
+        BNode* c = (BNode*)nd->ch[i];
+        assert(c->parent == nd && c->pslot == i);
+        i64 raw = 0, cur = 0, up = 0;
+        for (int j = 0; j < c->n; j++) {
+          raw += c->raw[j]; cur += c->cur[j]; up += c->up[j];
+        }
+        assert(nd->raw[i] == raw && nd->cur[i] == cur && nd->up[i] == up);
+        check_node(c);
+      }
+    }
+  }
+  void check() const {
+    check_node(root);
+    // every entry reachable via the linked list maps to its leaf
+    for (BLeaf* lf = first_leaf; lf; lf = lf->next) {
+      assert(lf->n > 0);
+      for (int i = 0; i < lf->n; i++) {
+        assert(lf->e[i].len > 0);
+        assert(index.query(lf->e[i].ids) == lf);
+        assert(index.query(lf->e[i].ide() - 1) == lf);
+      }
+    }
+  }
+#endif
 
   void advance_by_range(Span rng) {
     i64 start = rng.start, end = rng.end;
@@ -1018,6 +1463,11 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
       c->aa.local_to_agent(piece.lv, agent, seq);
       i64 alen = c->aa.span_len(piece.lv, plen);
       auto [consumed, xf] = tracker.apply(c->aa, agent, piece, alen);
+#ifdef DT_CHECK
+      fprintf(stderr, "applied lv=%lld len=%lld kind=%d\n",
+              (long long)piece.lv, (long long)consumed, (int)piece.kind);
+      tracker.check();
+#endif
       if (emit)
         c->out.push_back({piece.lv, consumed, piece.kind, piece.fwd, xf});
       if (consumed == plen) break;
