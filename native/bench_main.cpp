@@ -14,6 +14,7 @@ void dt_load_graph(void*, i64, const i64*, const i64*, const i64*, const i64*, c
 void dt_load_agent_runs(void*, i64, const i64*, const i64*, const i64*, const i64*);
 void dt_load_ops(void*, i64, const i64*, const u8*, const u8*, const i64*, const i64*, const i64*);
 i64 dt_transform(void*, const i64*, i64, const i64*, i64);
+void dt_prof_dump();
 }
 
 template <class T>
@@ -63,6 +64,7 @@ int main(int argc, char** argv) {
   i64 total = 0;
   for (int it = 0; it < iters; it++)
     total += dt_transform(ctx, nullptr, 0, ver.data(), ver.size());
+  dt_prof_dump();
   printf("transform out rows total: %lld\n", (long long)total);
   return 0;
 }

@@ -35,6 +35,10 @@ static const i64 UNDERWATER = 1ll << 62;
 
 // ---------------------------------------------------------------- utilities
 
+#ifdef DT_PROF
+static long g_diff_calls = 0, g_diff_iters = 0;
+#endif
+
 struct Span { i64 start, end; };
 
 static inline bool span_empty(const Span& s) { return s.end <= s.start; }
@@ -48,20 +52,27 @@ static void push_reversed_rle(std::vector<Span>& out, Span s) {
 
 struct Graph {
   std::vector<i64> starts, ends, shadows;
-  std::vector<std::vector<i64>> parents;
+  // parents in CSR layout (flat + indptr) for cache-friendly iteration
+  std::vector<i64> pindptr, pflat;
+  // dense LV -> entry index (LVs are 0..ends.back())
+  std::vector<int32_t> idx_of;
 
-  size_t find_idx(i64 v) const {
-    size_t lo = 0, hi = starts.size();
-    while (lo < hi) { size_t mid = (lo + hi) / 2;
-      if (starts[mid] <= v) lo = mid + 1; else hi = mid; }
-    return lo - 1;
+  inline size_t pn(size_t i) const { return pindptr[i + 1] - pindptr[i]; }
+  inline const i64* pb(size_t i) const { return pflat.data() + pindptr[i]; }
+
+  void build_idx() {
+    idx_of.assign(starts.empty() ? 0 : (size_t)ends.back(), 0);
+    for (size_t i = 0; i < starts.size(); i++)
+      for (i64 v = starts[i]; v < ends[i]; v++) idx_of[v] = (int32_t)i;
   }
+
+  inline size_t find_idx(i64 v) const { return idx_of[v]; }
 
   void parents_at(i64 v, std::vector<i64>& out) const {
     size_t i = find_idx(v);
     out.clear();
     if (v > starts[i]) out.push_back(v - 1);
-    else out = parents[i];
+    else out.assign(pb(i), pb(i) + pn(i));
   }
 
   bool entry_contains(size_t idx, i64 v) const {
@@ -73,22 +84,32 @@ struct Graph {
     return a > b && entry_contains(find_idx(a), b);
   }
 
+  mutable std::vector<i64> fcv_heap;
+
   bool frontier_contains_version(const std::vector<i64>& f, i64 target) const {
     if (target == ROOT) return true;
     for (i64 o : f) if (o == target) return true;
     if (f.empty()) return false;
     for (i64 o : f) if (o > target && shadows[find_idx(o)] <= target) return true;
-    std::priority_queue<i64> q;
-    for (i64 o : f) if (o > target) q.push(o);
+    std::vector<i64>& q = fcv_heap;
+    q.clear();
+    for (i64 o : f) if (o > target) q.push_back(o);
+    std::make_heap(q.begin(), q.end());
     while (!q.empty()) {
-      i64 order = q.top(); q.pop();
+      i64 order = q.front();
+      std::pop_heap(q.begin(), q.end()); q.pop_back();
       size_t i = find_idx(order);
       if (shadows[i] <= target) return true;
       i64 start = starts[i];
-      while (!q.empty() && q.top() >= start) q.pop();
-      for (i64 p : parents[i]) {
+      while (!q.empty() && q.front() >= start) {
+        std::pop_heap(q.begin(), q.end()); q.pop_back();
+      }
+      for (size_t k = 0; k < pn(i); k++) {
+        i64 p = pb(i)[k];
         if (p == target) return true;
-        if (p > target) q.push(p);
+        if (p > target) {
+          q.push_back(p); std::push_heap(q.begin(), q.end());
+        }
       }
     }
     return false;
@@ -114,42 +135,56 @@ struct Graph {
     diff_slow(a, b, only_a, only_b);
   }
 
+  mutable std::vector<std::pair<i64, u8>> diff_heap;
+
   void diff_slow(const std::vector<i64>& a, const std::vector<i64>& b,
                  std::vector<Span>& only_a, std::vector<Span>& only_b) const {
     // max-heap of (lv, flag)
-    std::priority_queue<std::pair<i64, u8>> q;
-    for (i64 v : a) q.push({v, OnlyA});
-    for (i64 v : b) q.push({v, OnlyB});
+    std::vector<std::pair<i64, u8>>& q = diff_heap;
+#ifdef DT_PROF
+    g_diff_calls++;
+#endif
+    q.clear();
+    for (i64 v : a) q.push_back({v, OnlyA});
+    for (i64 v : b) q.push_back({v, OnlyB});
+    std::make_heap(q.begin(), q.end());
     long num_shared = 0;
 
     auto mark = [&](i64 lo, i64 hi, u8 flag) {
       if (flag == Shared) return;
       push_reversed_rle(flag == OnlyA ? only_a : only_b, {lo, hi + 1});
     };
+    auto pop = [&]() { std::pop_heap(q.begin(), q.end()); q.pop_back(); };
+    auto push = [&](i64 v, u8 f) {
+      q.push_back({v, f}); std::push_heap(q.begin(), q.end());
+    };
 
     while (!q.empty()) {
-      auto [ord, flag] = q.top(); q.pop();
+#ifdef DT_PROF
+      g_diff_iters++;
+#endif
+      auto [ord, flag] = q.front(); pop();
       if (flag == Shared) num_shared--;
-      while (!q.empty() && q.top().first == ord) {
-        u8 pf = q.top().second; q.pop();
+      while (!q.empty() && q.front().first == ord) {
+        u8 pf = q.front().second; pop();
         if (pf != flag) flag = Shared;
         if (pf == Shared) num_shared--;
       }
       size_t i = find_idx(ord);
       i64 start = starts[i];
-      while (!q.empty() && q.top().first >= start) {
-        i64 peek_ord = q.top().first; u8 pf = q.top().second;
+      while (!q.empty() && q.front().first >= start) {
+        i64 peek_ord = q.front().first; u8 pf = q.front().second;
         if (pf != flag) {
           mark(peek_ord + 1, ord, flag);
           ord = peek_ord;
           flag = Shared;
         }
         if (pf == Shared) num_shared--;
-        q.pop();
+        pop();
       }
       mark(start, ord, flag);
-      for (i64 p : parents[i]) {
-        q.push({p, flag});
+      for (size_t k = 0; k < pn(i); k++) {
+        push(pb(i)[k], flag);
         if (flag == Shared) num_shared++;
       }
       if ((long)q.size() == num_shared) break;
@@ -199,6 +234,14 @@ struct Graph {
       t.merged.assign(f.begin(), f.end() - 1);
       return t;
     };
+    auto tpp = [this](size_t i) {
+      TimePoint t;
+      size_t n = pn(i);
+      if (n == 0) { t.last = ROOT; return t; }
+      t.last = pb(i)[n - 1];
+      t.merged.assign(pb(i), pb(i) + n - 1);
+      return t;
+    };
     std::priority_queue<std::pair<TimePoint, u8>> q;
     q.push({tp(a), OnlyA});
     q.push({tp(b), OnlyB});
@@ -234,7 +277,7 @@ struct Graph {
             if (next_flag != flag) flag = Shared;
           } else {
             visit(rng, flag);
-            q.push({tp(parents[i]), flag});
+            q.push({tpp(i), flag});
             break;
           }
         } else {
@@ -281,7 +324,7 @@ struct Graph {
       i64 t_start = starts[i];
       if (f.size() == 1) {
         if (start > t_start) { f[0] = start - 1; break; }
-        f = parents[i];
+        f.assign(pb(i), pb(i) + pn(i));
       } else {
         f.erase(std::remove(f.begin(), f.end(), last_order), f.end());
         parents_at(std::max(start, t_start), ps);
@@ -308,7 +351,19 @@ struct Agents {
   struct GRun { i64 lv0, lv1; i64 agent, seq0; };
   std::vector<GRun> global_runs;
 
-  const GRun& find_global(i64 lv) const {
+  std::vector<int32_t> idx_of;  // dense LV -> global run index
+
+  void build_idx() {
+    i64 top = 0;
+    for (const GRun& g : global_runs) top = std::max(top, g.lv1);
+    idx_of.assign((size_t)top, 0);
+    for (size_t i = 0; i < global_runs.size(); i++)
+      for (i64 v = global_runs[i].lv0; v < global_runs[i].lv1; v++)
+        idx_of[v] = (int32_t)i;
+  }
+
+  inline const GRun& find_global(i64 lv) const {
+    if (lv < (i64)idx_of.size()) return global_runs[idx_of[lv]];
     size_t lo = 0, hi = global_runs.size();
     while (lo < hi) { size_t mid = (lo + hi) / 2;
       if (global_runs[mid].lv0 <= lv) lo = mid + 1; else hi = mid; }
@@ -334,8 +389,20 @@ static const u8 INS = 0, DEL = 1;
 
 struct Ops {
   std::vector<OpRun> runs;
+  std::vector<int32_t> idx_of;  // dense LV -> run index
 
-  size_t find_idx(i64 lv) const {
+  void build_idx() {
+    i64 top = 0;
+    for (const OpRun& r : runs) top = std::max(top, r.lv + (r.end - r.start));
+    idx_of.assign((size_t)top, 0);
+    for (size_t i = 0; i < runs.size(); i++) {
+      i64 e = runs[i].lv + (runs[i].end - runs[i].start);
+      for (i64 v = runs[i].lv; v < e; v++) idx_of[v] = (int32_t)i;
+    }
+  }
+
+  inline size_t find_idx(i64 lv) const {
+    if (lv < (i64)idx_of.size()) return idx_of[lv];
     size_t lo = 0, hi = runs.size();
     while (lo < hi) { size_t mid = (lo + hi) / 2;
       if (runs[mid].lv <= lv) lo = mid + 1; else hi = mid; }
@@ -831,26 +898,50 @@ struct Tracker {
 
   // ---- lookup ----
 
+  mutable BLeaf* hint_leaf = nullptr;
+  mutable int hint_idx = 0;
+
   // (leaf, idx) of the entry containing lv
   std::pair<BLeaf*, int> ins_lookup(i64 lv) const {
+    // LV ranges are globally disjoint, so a containment hit on the hint is
+    // always the right entry; leaves live in a pool, so probing is safe.
+    BLeaf* h = hint_leaf;
+    if (h) {
+      int i = hint_idx;
+      if (i < h->n && h->e[i].ids <= lv && lv < h->e[i].ide()) return {h, i};
+      if (i + 1 < h->n && h->e[i + 1].ids <= lv && lv < h->e[i + 1].ide()) {
+        hint_idx = i + 1;
+        return {h, i + 1};
+      }
+    }
     BLeaf* lf = index.query(lv);
     for (int i = 0; i < lf->n; i++)
-      if (lf->e[i].ids <= lv && lv < lf->e[i].ide()) return {lf, i};
+      if (lf->e[i].ids <= lv && lv < lf->e[i].ide()) {
+        hint_leaf = lf; hint_idx = i;
+        return {lf, i};
+      }
     assert(false && "ins_lookup: lv not in mapped leaf");
     return {nullptr, 0};
   }
 
-  Cursor find_by_cur(i64 pos) const {
+  // Returns the cursor for current-position pos; *up_out (optional) gets
+  // the upstream-length prefix BEFORE the returned entry.
+  Cursor find_by_cur(i64 pos, i64* up_out = nullptr) const {
     BNode* nd = root;
+    i64 up = 0;
     while (true) {
       int i = 0;
-      while (pos >= nd->cur[i]) { pos -= nd->cur[i]; i++; assert(i < nd->n); }
+      while (pos >= nd->cur[i]) {
+        pos -= nd->cur[i]; up += nd->up[i]; i++;
+        assert(i < nd->n);
+      }
       if (nd->leaf_children) {
         BLeaf* lf = (BLeaf*)nd->ch[i];
         for (int j = 0; j < lf->n; j++) {
           i64 c = lf->e[j].cur();
-          if (pos < c) return {lf, j, pos};
+          if (pos < c) { if (up_out) *up_out = up; return {lf, j, pos}; }
           pos -= c;
+          up += lf->e[j].up();
         }
         assert(false && "find_by_cur: pos out of range");
       }
@@ -1015,15 +1106,33 @@ struct Tracker {
     index.set_range(ent.ids, ent.len, l3);
   }
 
+  // `up` is the upstream-length prefix before cursor's entry; threaded
+  // through the scan so the final position needs no tree climb.
   i64 integrate(const Agents& aa, i64 agent, const BEntry& item,
-                Cursor cursor) {
-    bool at_end = !roll(cursor);
+                Cursor cursor, i64 up) {
+    // roll, accumulating crossed entries into the upstream prefix
+    auto roll_up = [&](Cursor& c) -> bool {
+      if (!c.leaf) return false;
+      while (c.off >= c.leaf->e[c.idx].len) {
+        c.off -= c.leaf->e[c.idx].len;
+        up += c.leaf->e[c.idx].up();
+        c.idx++;
+        while (c.idx >= c.leaf->n) {
+          if (!c.leaf->next) { c.leaf = nullptr; c.idx = 0; c.off = 0; return false; }
+          c.leaf = c.leaf->next;
+          c.idx = 0;
+        }
+      }
+      return true;
+    };
+    bool at_end = !roll_up(cursor);
     Cursor left_cursor = cursor;
     Cursor scan_start = cursor;
+    i64 scan_up = up;
     bool scanning = false;
 
     while (!at_end && cursor.leaf) {
-      if (!roll(cursor)) break;
+      if (!roll_up(cursor)) break;
       const BEntry& other = cursor.leaf->e[cursor.idx];
       i64 off = cursor.off;
       i64 other_lv = other.ids + off;
@@ -1053,18 +1162,21 @@ struct Tracker {
           Cursor mr = cursor_before_item(item.orr);
           Cursor orc = cursor_before_item(other.orr);
           if (cmp_cursors(orc, mr) < 0) {
-            if (!scanning) { scanning = true; scan_start = cursor; }
+            if (!scanning) { scanning = true; scan_start = cursor; scan_up = up; }
           } else scanning = false;
         }
       }
+      up += cursor.leaf->e[cursor.idx].up();
       if (!next_entry(cursor)) {
         cursor = {nullptr, 0, 0};
         break;
       }
     }
-    if (scanning) cursor = scan_start;
+    if (scanning) { cursor = scan_start; up = scan_up; }
     Cursor at = cursor.leaf ? cursor : Cursor{nullptr, 0, 0};
-    i64 pos = upstream_pos(at);
+    i64 pos;
+    if (!at.leaf) pos = up;
+    else pos = up + (at.leaf->e[at.idx].ever ? 0 : at.off);
     insert_at(at, item);
     return pos;
   }
@@ -1077,12 +1189,12 @@ struct Tracker {
       assert(op.fwd && "reverse insert runs unsupported");
       i64 origin_left;
       Cursor cursor;
+      i64 up_prefix = 0;
       if (op.start == 0) {
         origin_left = ROOT;
         cursor = {first_leaf, 0, 0};
-        // first_leaf may start empty-rolled; roll handled in integrate
       } else {
-        Cursor c = find_by_cur(op.start - 1);
+        Cursor c = find_by_cur(op.start - 1, &up_prefix);
         origin_left = c.leaf->e[c.idx].ids + c.off;
         cursor = {c.leaf, c.idx, c.off + 1};
       }
@@ -1098,18 +1210,19 @@ struct Tracker {
         }
       }
       BEntry item{op.lv, length, origin_left, origin_right, 1, false};
-      i64 pos = integrate(aa, agent, item, cursor);
+      i64 pos = integrate(aa, agent, item, cursor, up_prefix);
       return {length, pos};
     } else {
       bool fwd = op.fwd;
       Cursor cursor;
       i64 take_req;
+      i64 up_prefix = 0;
       if (fwd) {
-        cursor = find_by_cur(op.start);
+        cursor = find_by_cur(op.start, &up_prefix);
         take_req = length;
       } else {
         i64 last_pos = op.end - 1;
-        Cursor c = find_by_cur(last_pos);
+        Cursor c = find_by_cur(last_pos, &up_prefix);
         i64 entry_start_pos = last_pos - c.off;
         i64 edit_start = std::max(entry_start_pos, op.end - length);
         take_req = op.end - edit_start;
@@ -1120,7 +1233,8 @@ struct Tracker {
       i64 off = cursor.off;
       assert(lf->e[idx].state == 1);
       bool ever_deleted = lf->e[idx].ever;
-      i64 del_start_xf = upstream_pos(cursor);
+      i64 del_start_xf =
+          up_prefix + (lf->e[idx].ever ? 0 : off);
       i64 take = std::min(take_req, lf->e[idx].len - off);
       if (off > 0) {
         auto [l2, i2] = split_entry(lf, idx, off);
@@ -1269,6 +1383,38 @@ struct Tracker {
   }
 };
 
+#ifdef DT_PROF
+#include <x86intrin.h>
+struct ProfCounters {
+  unsigned long long diff = 0, walk_fr = 0, retreat = 0, advance = 0,
+                     apply_ins = 0, apply_del = 0, emit_misc = 0, doc = 0,
+                     conflict = 0;
+} g_prof;
+struct ProfScope {
+  unsigned long long* tgt;
+  unsigned long long t0;
+  ProfScope(unsigned long long* t) : tgt(t), t0(__rdtsc()) {}
+  ~ProfScope() { *tgt += __rdtsc() - t0; }
+};
+#define PROF(field) ProfScope _ps(&g_prof.field)
+extern "C" void dt_prof_dump() {
+  fprintf(stderr,
+          "prof cycles: diff=%llu walk_fr=%llu retreat=%llu advance=%llu "
+          "apply_ins=%llu apply_del=%llu emit_misc=%llu doc=%llu "
+          "conflict=%llu\n",
+          g_prof.diff, g_prof.walk_fr, g_prof.retreat, g_prof.advance,
+          g_prof.apply_ins, g_prof.apply_del, g_prof.emit_misc, g_prof.doc,
+          g_prof.conflict);
+  fprintf(stderr, "diff calls=%ld iters=%ld\n", g_diff_calls, g_diff_iters);
+  g_diff_calls = g_diff_iters = 0;
+  g_prof = ProfCounters{};
+}
+#else
+#define PROF(field)
+extern "C" void dt_prof_dump() {}
+#endif
+
+
 // ---------------------------------------------------------------- walker
 
 struct VisitEntry {
@@ -1340,11 +1486,13 @@ struct Walker {
     VisitEntry& e = input[idx];
     e.visited = true;
 
-    g.diff_rev(frontier, e.parents, retreat, advance_rev);
-    for (const Span& s : retreat) g.retreat(frontier, s);
-    for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
-      g.advance(frontier, *it);
-    g.advance_known_run(frontier, e.parents, e.span);
+    { PROF(diff); g.diff_rev(frontier, e.parents, retreat, advance_rev); }
+    { PROF(walk_fr);
+      for (const Span& s : retreat) g.retreat(frontier, s);
+      for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+        g.advance(frontier, *it);
+      g.advance_known_run(frontier, e.parents, e.span);
+    }
 
     for (int c : e.child_idxs) {
       if (input[c].visited) continue;
@@ -1462,7 +1610,10 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
       i64 agent, seq;
       c->aa.local_to_agent(piece.lv, agent, seq);
       i64 alen = c->aa.span_len(piece.lv, plen);
-      auto [consumed, xf] = tracker.apply(c->aa, agent, piece, alen);
+      std::pair<i64,i64> r;
+      if (piece.kind == INS) { PROF(apply_ins); r = tracker.apply(c->aa, agent, piece, alen); }
+      else { PROF(apply_del); r = tracker.apply(c->aa, agent, piece, alen); }
+      auto [consumed, xf] = r;
 #ifdef DT_CHECK
       fprintf(stderr, "applied lv=%lld len=%lld kind=%d\n",
               (long long)piece.lv, (long long)consumed, (int)piece.kind);
@@ -1481,10 +1632,13 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
 static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->out.clear();
   std::vector<Span> new_ops, conflict_ops;
-  std::vector<i64> common = c->g.find_conflicting(
-      from, merge, [&](Span s, u8 flag) {
-        push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
-      });
+  std::vector<i64> common;
+  { PROF(conflict);
+    common = c->g.find_conflicting(
+        from, merge, [&](Span s, u8 flag) {
+          push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
+        });
+  }
 
   std::vector<i64> next_frontier = from;
   bool did_ff = false;
@@ -1533,17 +1687,21 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
       std::vector<Span> retreat, advance_rev;
       Span consume;
       while (w.next(retreat, advance_rev, consume)) {
-        for (const Span& s : retreat) tracker.retreat_by_range(s);
-        for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
-          tracker.advance_by_range(*it);
+        { PROF(retreat);
+          for (const Span& s : retreat) tracker.retreat_by_range(s); }
+        { PROF(advance);
+          for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+            tracker.advance_by_range(*it); }
         emit_ops_range(c, tracker, consume, false);
       }
       // walk new ops
       Walker w2(c->g, new_ops, w.frontier);
       while (w2.next(retreat, advance_rev, consume)) {
-        for (const Span& s : retreat) tracker.retreat_by_range(s);
-        for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
-          tracker.advance_by_range(*it);
+        { PROF(retreat);
+          for (const Span& s : retreat) tracker.retreat_by_range(s); }
+        { PROF(advance);
+          for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
+            tracker.advance_by_range(*it); }
         c->g.advance(next_frontier, consume);
         emit_ops_range(c, tracker, consume, true);
       }
@@ -1572,9 +1730,9 @@ void dt_load_graph(void* p, i64 n, const i64* starts, const i64* ends,
   c->g.starts.assign(starts, starts + n);
   c->g.ends.assign(ends, ends + n);
   c->g.shadows.assign(shadows, shadows + n);
-  c->g.parents.resize(n);
-  for (i64 i = 0; i < n; i++)
-    c->g.parents[i].assign(pflat + pindptr[i], pflat + pindptr[i + 1]);
+  c->g.pindptr.assign(pindptr, pindptr + n + 1);
+  c->g.pflat.assign(pflat, pflat + pindptr[n]);
+  c->g.build_idx();
 }
 
 void dt_load_agent_runs(void* p, i64 n, const i64* lv0, const i64* lv1,
@@ -1591,6 +1749,7 @@ void dt_load_agent_runs(void* p, i64 n, const i64* lv0, const i64* lv1,
               [](const AgentRun& a, const AgentRun& b) {
                 return a.seq_start < b.seq_start;
               });
+  c->aa.build_idx();
 }
 
 void dt_load_ops(void* p, i64 n, const i64* lv, const u8* kind,
@@ -1601,6 +1760,7 @@ void dt_load_ops(void* p, i64 n, const i64* lv, const u8* kind,
   c->ops.runs.reserve(n);
   for (i64 i = 0; i < n; i++)
     c->ops.runs.push_back({lv[i], kind[i], fwd[i], start[i], end[i], cp[i]});
+  c->ops.build_idx();
 }
 
 void dt_load_ins_arena(void* p, i64 n, const int32_t* chars) {
@@ -1625,6 +1785,7 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
   if (init_len > 0) c->doc.insert(0, init, init_len);
   transform(c, std::vector<i64>(from, from + nf),
             std::vector<i64>(merge, merge + nm));
+  PROF(doc);
   for (const XfOp& x : c->out) {
     if (x.pos < 0) continue;
     if (x.kind == INS) {
