@@ -1,5 +1,6 @@
 // Standalone profiling harness for dt_core: loads columnar dumps produced by
 // tools/dump_columns.py and runs the transform repeatedly (for gprof).
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <vector>
@@ -62,9 +63,16 @@ int main(int argc, char** argv) {
   auto ver = read_vec<i64>(f);
   fclose(f);
   i64 total = 0;
-  for (int it = 0; it < iters; it++)
+  double best = 1e18;
+  for (int it = 0; it < iters; it++) {
+    auto t0 = std::chrono::steady_clock::now();
     total += dt_transform(ctx, nullptr, 0, ver.data(), ver.size());
+    double dt = std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count();
+    if (dt < best) best = dt;
+  }
   dt_prof_dump();
+  printf("best transform: %.2f ms\n", best * 1e3);
   printf("transform out rows total: %lld\n", (long long)total);
   return 0;
 }

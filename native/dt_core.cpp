@@ -23,6 +23,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <queue>
 #include <string>
 #include <vector>
@@ -37,6 +38,7 @@ static const i64 UNDERWATER = 1ll << 62;
 
 #ifdef DT_PROF
 static long g_diff_calls = 0, g_diff_iters = 0;
+long g_walk_steps = 0, g_walk_zero = 0, g_diff_iters2 = 0;
 #endif
 
 struct Span { i64 start, end; };
@@ -56,6 +58,9 @@ struct Graph {
   std::vector<i64> pindptr, pflat;
   // dense LV -> entry index (LVs are 0..ends.back())
   std::vector<int32_t> idx_of;
+  // diff-hot per-entry data packed in one line: start + inline parents
+  struct DiffEnt { i64 start; int32_t np; i64 p[2]; };
+  std::vector<DiffEnt> dent;
 
   inline size_t pn(size_t i) const { return pindptr[i + 1] - pindptr[i]; }
   inline const i64* pb(size_t i) const { return pflat.data() + pindptr[i]; }
@@ -64,6 +69,13 @@ struct Graph {
     idx_of.assign(starts.empty() ? 0 : (size_t)ends.back(), 0);
     for (size_t i = 0; i < starts.size(); i++)
       for (i64 v = starts[i]; v < ends[i]; v++) idx_of[v] = (int32_t)i;
+    dent.resize(starts.size());
+    for (size_t i = 0; i < starts.size(); i++) {
+      dent[i].start = starts[i];
+      size_t n = pn(i);
+      dent[i].np = (int32_t)n;
+      for (size_t k = 0; k < n && k < 2; k++) dent[i].p[k] = pb(i)[k];
+    }
   }
 
   inline size_t find_idx(i64 v) const { return idx_of[v]; }
@@ -171,7 +183,8 @@ struct Graph {
         if (pf == Shared) num_shared--;
       }
       size_t i = find_idx(ord);
-      i64 start = starts[i];
+      const DiffEnt& de = dent[i];
+      i64 start = de.start;
       while (!q.empty() && q.front().first >= start) {
         i64 peek_ord = q.front().first; u8 pf = q.front().second;
         if (pf != flag) {
@@ -183,8 +196,9 @@ struct Graph {
         pop();
       }
       mark(start, ord, flag);
-      for (size_t k = 0; k < pn(i); k++) {
-        push(pb(i)[k], flag);
+      const i64* pp = de.np <= 2 ? de.p : pb(i);
+      for (int32_t k = 0; k < de.np; k++) {
+        push(pp[k], flag);
         if (flag == Shared) num_shared++;
       }
       if ((long)q.size() == num_shared) break;
@@ -728,9 +742,13 @@ struct Tracker {
   BNode* root;
   BLeaf* first_leaf;
   SpaceIndex index;
-  std::map<i64, DelRow> del_rows;  // keyed by lv0
+  // delete targets: op LVs are dense, so an O(1) run table replaces the
+  // reference's marker-tree DelTarget entries (src/listmerge/markers.rs)
+  std::vector<DelRow> del_list;
+  std::vector<int32_t> del_run_of;  // op lv -> del_list index, -1 = none
 
-  Tracker() {
+  explicit Tracker(i64 ops_top = 0) {
+    del_run_of.assign((size_t)ops_top, -1);
     leaf_pool.emplace_back();
     node_pool.emplace_back();
     root = &node_pool.back();
@@ -1252,7 +1270,11 @@ struct Tracker {
       en.ever = true;
       bump(lf, 0, dcur, dup);
 
-      del_rows[op.lv] = DelRow{op.lv, op.lv + take, t0, t1, fwd};
+      if (op.lv + take <= (i64)del_run_of.size()) {
+        int32_t ri = (int32_t)del_list.size();
+        del_list.push_back(DelRow{op.lv, op.lv + take, t0, t1, fwd});
+        for (i64 v = op.lv; v < op.lv + take; v++) del_run_of[v] = ri;
+      }
       return {take, ever_deleted ? -1 : del_start_xf};
     }
   }
@@ -1262,11 +1284,9 @@ struct Tracker {
   struct QueryRes { u8 kind; i64 t0, t1; bool fwd; i64 offset, total; };
 
   QueryRes index_query(i64 lv) const {
-    auto it = del_rows.upper_bound(lv);
-    if (it != del_rows.begin()) {
-      const DelRow& r = std::prev(it)->second;
-      if (r.lv0 <= lv && lv < r.lv1)
-        return {DEL, r.t0, r.t1, r.fwd, lv - r.lv0, r.lv1 - r.lv0};
+    if (lv < (i64)del_run_of.size() && del_run_of[lv] >= 0) {
+      const DelRow& r = del_list[del_run_of[lv]];
+      return {DEL, r.t0, r.t1, r.fwd, lv - r.lv0, r.lv1 - r.lv0};
     }
     auto [lf, i] = ins_lookup(lv);
     const BEntry& e = lf->e[i];
@@ -1405,8 +1425,11 @@ extern "C" void dt_prof_dump() {
           g_prof.diff, g_prof.walk_fr, g_prof.retreat, g_prof.advance,
           g_prof.apply_ins, g_prof.apply_del, g_prof.emit_misc, g_prof.doc,
           g_prof.conflict);
-  fprintf(stderr, "diff calls=%ld iters=%ld\n", g_diff_calls, g_diff_iters);
-  g_diff_calls = g_diff_iters = 0;
+  fprintf(stderr,
+          "diff calls=%ld iters=%ld local_iters=%ld walk steps=%ld zero=%ld\n",
+          g_diff_calls, g_diff_iters, g_diff_iters2, g_walk_steps,
+          g_walk_zero);
+  g_diff_calls = g_diff_iters = g_diff_iters2 = g_walk_steps = g_walk_zero = 0;
   g_prof = ProfCounters{};
 }
 #else
@@ -1416,65 +1439,228 @@ extern "C" void dt_prof_dump() {}
 
 
 // ---------------------------------------------------------------- walker
+//
+// Conflict-zone walker over a LOCAL piece graph (the listmerge2
+// "conflict subgraph" idea, reference src/listmerge2/conflict_subgraph.rs,
+// applied to the M1 pipeline): the conflict + new-op spans are chopped at
+// graph-entry boundaries AND at every parent reference, so every frontier
+// that can arise during the walk is exactly a set of piece-ends. Diffs then
+// run over int32 piece indices with a small binary heap instead of heap
+// walks over the global graph. Because each step's diff moves the frontier
+// exactly onto the consumed piece's parents, the frontier after each
+// consume is the single head {piece}, so no global frontier maintenance is
+// needed inside the walk (reference equivalent: txn_trace.rs:75-160).
 
-struct VisitEntry {
+struct Piece {
   Span span;
-  std::vector<i64> parents;
-  std::vector<int> parent_idxs, child_idxs;
+  int32_t pstart, np;   // local parents slice into Zone::lpar
+  u8 np_global;          // parent count incl. out-of-zone (walk heuristic)
+  u8 phase;              // 0 = conflict (seed tracker), 1 = new ops (emit)
   bool visited = false;
 };
 
-struct Walker {
-  const Graph& g;
-  std::vector<i64> frontier;
-  std::vector<VisitEntry> input;
-  std::vector<int> to_process;
+struct Zone {
+  std::vector<Piece> pieces;       // ascending LV order
+  std::vector<int32_t> lpar;       // flat local parent idxs
+  std::vector<int32_t> cindptr, cflat;  // children CSR
+  std::vector<int32_t> pending;    // unvisited local parent count
+  int32_t last_head = -1;          // last consumed piece (shared across phases)
+  // scratch for diff_local
+  std::vector<std::pair<int32_t, u8>> heap;
 
-  Walker(const Graph& graph, const std::vector<Span>& rev_spans,
-         std::vector<i64> start_at)
-      : g(graph), frontier(std::move(start_at)) {
-    auto find_entry_idx = [&](i64 t) -> int {
-      int lo = 0, hi = (int)input.size();
-      while (lo < hi) {
-        int mid = (lo + hi) / 2;
-        if (t < input[mid].span.start) hi = mid;
-        else if (t >= input[mid].span.end) lo = mid + 1;
-        else return mid;
+  // a, b: descending span lists (phase 0 / phase 1)
+  Zone(const Graph& g, const std::vector<Span>& conflict,
+       const std::vector<Span>& fresh) {
+    // 1. merge into ascending (span, phase) list
+    struct SP { Span s; u8 phase; };
+    std::vector<SP> spans;
+    spans.reserve(conflict.size() + fresh.size());
+    {
+      auto ia = conflict.rbegin(), ea = conflict.rend();
+      auto ib = fresh.rbegin(), eb = fresh.rend();
+      while (ia != ea || ib != eb) {
+        if (ib == eb || (ia != ea && ia->start < ib->start))
+          spans.push_back({*ia++, 0});
+        else
+          spans.push_back({*ib++, 1});
       }
-      return -1;
-    };
-    for (auto it = rev_spans.rbegin(); it != rev_spans.rend(); ++it) {
-      i64 start = it->start, end = it->end;
+    }
+    // 2. chop at graph entry boundaries -> proto piece spans
+    struct Proto { Span s; u8 phase; bool entry_head; };
+    std::vector<Proto> protos;
+    for (const SP& sp : spans) {
+      i64 start = sp.s.start, end = sp.s.end;
       size_t i = g.find_idx(start);
       while (start < end) {
         i64 t_end = std::min(g.ends[i], end);
-        VisitEntry e;
-        e.span = {start, t_end};
-        g.parents_at(start, e.parents);
-        for (i64 p : e.parents) {
-          int pi = find_entry_idx(p);
-          if (pi >= 0) e.parent_idxs.push_back(pi);
-        }
-        if (e.parent_idxs.empty()) to_process.push_back((int)input.size());
-        input.push_back(std::move(e));
+        protos.push_back({{start, t_end}, sp.phase, start == g.starts[i]});
         start = t_end;
         i++;
       }
     }
-    for (int i = 0; i < (int)input.size(); i++)
-      for (int p : input[i].parent_idxs) input[p].child_idxs.push_back(i);
-    std::reverse(to_process.begin(), to_process.end());
+    // 3. collect split points: every parent reference p with p+1 strictly
+    //    inside a piece forces a boundary at p+1
+    std::vector<i64> cuts;
+    std::vector<i64> ps;
+    auto find_proto = [&](i64 v) -> int {
+      int lo = 0, hi = (int)protos.size();
+      while (lo < hi) {
+        int mid = (lo + hi) / 2;
+        if (v < protos[mid].s.start) hi = mid;
+        else if (v >= protos[mid].s.end) lo = mid + 1;
+        else return mid;
+      }
+      return -1;
+    };
+    for (const Proto& pr : protos) {
+      if (!pr.entry_head) continue;  // mid-entry pieces: single parent start-1
+      size_t gi = g.find_idx(pr.s.start);
+      for (size_t k = 0; k < g.pn(gi); k++) {
+        i64 p = g.pb(gi)[k];
+        int pi = find_proto(p);
+        if (pi >= 0 && p + 1 > protos[pi].s.start && p + 1 < protos[pi].s.end)
+          cuts.push_back(p + 1);
+      }
+    }
+    std::sort(cuts.begin(), cuts.end());
+    cuts.erase(std::unique(cuts.begin(), cuts.end()), cuts.end());
+    // 4. final pieces
+    size_t ci = 0;
+    for (const Proto& pr : protos) {
+      while (ci < cuts.size() && cuts[ci] <= pr.s.start) ci++;
+      i64 start = pr.s.start;
+      bool head = pr.entry_head;
+      size_t cj = ci;
+      while (start < pr.s.end) {
+        i64 end = pr.s.end;
+        if (cj < cuts.size() && cuts[cj] < end) end = cuts[cj++];
+        Piece p;
+        p.span = {start, end};
+        p.phase = pr.phase;
+        p.np_global = head ? 2 : 1;  // refined below for true heads
+        p.pstart = 0; p.np = 0;
+        pieces.push_back(p);
+        start = end;
+        head = false;
+      }
+    }
+    // 5. local parents
+    auto find_piece = [&](i64 v) -> int {
+      int lo = 0, hi = (int)pieces.size();
+      while (lo < hi) {
+        int mid = (lo + hi) / 2;
+        if (v < pieces[mid].span.start) hi = mid;
+        else if (v >= pieces[mid].span.end) lo = mid + 1;
+        else return mid;
+      }
+      return -1;
+    };
+    for (size_t i = 0; i < pieces.size(); i++) {
+      Piece& p = pieces[i];
+      size_t gi = g.find_idx(p.span.start);
+      p.pstart = (int32_t)lpar.size();
+      if (p.span.start == g.starts[gi]) {
+        p.np_global = (u8)std::min<size_t>(g.pn(gi), 255);
+        for (size_t k = 0; k < g.pn(gi); k++) {
+          int pi = find_piece(g.pb(gi)[k]);
+          if (pi >= 0) {
+            assert(g.pb(gi)[k] == pieces[pi].span.end - 1);
+            lpar.push_back(pi);
+          }
+        }
+      } else {
+        p.np_global = 1;
+        int pi = find_piece(p.span.start - 1);
+        if (pi >= 0) {
+          assert((i64)pi == (i64)i - 1 || pieces[pi].span.end == p.span.start);
+          lpar.push_back(pi);
+        }
+      }
+      p.np = (int32_t)(lpar.size() - p.pstart);
+    }
+    // 6. children CSR + pending counters
+    cindptr.assign(pieces.size() + 1, 0);
+    for (int32_t pi : lpar) cindptr[pi + 1]++;
+    for (size_t i = 0; i < pieces.size(); i++) cindptr[i + 1] += cindptr[i];
+    cflat.resize(lpar.size());
+    {
+      std::vector<int32_t> fill(cindptr.begin(), cindptr.end() - 1);
+      for (size_t i = 0; i < pieces.size(); i++)
+        for (int32_t k = 0; k < pieces[i].np; k++)
+          cflat[fill[lpar[pieces[i].pstart + k]]++] = (int32_t)i;
+    }
+    pending.resize(pieces.size());
+    for (size_t i = 0; i < pieces.size(); i++) pending[i] = pieces[i].np;
+  }
+
+  // diff between head closure and parents closure, over local idxs.
+  // Appends descending piece idxs to retreat (head-only) / advance
+  // (parents-only).
+  void diff_local(int32_t head, const int32_t* par, int32_t np,
+                  std::vector<int32_t>& retreat_i,
+                  std::vector<int32_t>& advance_i) {
+    enum : u8 { A = 0, B = 1, Shared = 2 };
+#ifdef DT_PROF
+    extern long g_walk_steps, g_walk_zero, g_diff_iters2;
+    g_walk_steps++;
+    if (np == 1 && par[0] == head) g_walk_zero++;
+#endif
+    if (np == 1 && par[0] == head) return;  // zero-churn chain step
+    heap.clear();
+    if (head >= 0) heap.push_back({head, A});
+    for (int32_t k = 0; k < np; k++) heap.push_back({par[k], B});
+    std::make_heap(heap.begin(), heap.end());
+    long num_shared = 0;
+    while (!heap.empty()) {
+#ifdef DT_PROF
+      g_diff_iters2++;
+#endif
+      auto [idx, flag] = heap.front();
+      std::pop_heap(heap.begin(), heap.end()); heap.pop_back();
+      if (flag == Shared) num_shared--;
+      while (!heap.empty() && heap.front().first == idx) {
+        u8 pf = heap.front().second;
+        std::pop_heap(heap.begin(), heap.end()); heap.pop_back();
+        if (pf != flag) flag = Shared;
+        if (pf == Shared) num_shared--;
+      }
+      if (flag == A) retreat_i.push_back(idx);
+      else if (flag == B) advance_i.push_back(idx);
+      const Piece& p = pieces[idx];
+      for (int32_t k = 0; k < p.np; k++) {
+        heap.push_back({lpar[p.pstart + k], flag});
+        std::push_heap(heap.begin(), heap.end());
+        if (flag == Shared) num_shared++;
+      }
+      if ((long)heap.size() == num_shared) break;
+    }
+  }
+};
+
+struct Walker {
+  Zone& z;
+  u8 phase;
+  std::vector<int32_t> to_process;
+  std::vector<int32_t> retreat_i, advance_i;
+
+  Walker(Zone& zone, u8 ph) : z(zone), phase(ph) {
+    for (int i = (int)z.pieces.size() - 1; i >= 0; i--)
+      if (z.pieces[i].phase == phase && !z.pieces[i].visited &&
+          z.pending[i] == 0)
+        to_process.push_back(i);
   }
 
   // returns false when done
   bool next(std::vector<Span>& retreat, std::vector<Span>& advance_rev,
             Span& consume) {
     if (to_process.empty()) return false;
-    int idx = to_process.back();
-    if (input[idx].parents.size() >= 2) {
+    // reference heuristic (txn_trace.rs:240-258): defer merge pieces,
+    // preferring the most recently readied non-merge piece
+    int32_t idx = to_process.back();
+    if (z.pieces[idx].np_global >= 2) {
       int found = -1;
       for (int ii = (int)to_process.size() - 1; ii >= 0; ii--) {
-        if (input[to_process[ii]].parents.size() < 2) { found = ii; break; }
+        if (z.pieces[to_process[ii]].np_global < 2) { found = ii; break; }
       }
       if (found >= 0) {
         idx = to_process[found];
@@ -1483,23 +1669,25 @@ struct Walker {
       } else to_process.pop_back();
     } else to_process.pop_back();
 
-    VisitEntry& e = input[idx];
+    Piece& e = z.pieces[idx];
     e.visited = true;
 
-    { PROF(diff); g.diff_rev(frontier, e.parents, retreat, advance_rev); }
-    { PROF(walk_fr);
-      for (const Span& s : retreat) g.retreat(frontier, s);
-      for (auto it = advance_rev.rbegin(); it != advance_rev.rend(); ++it)
-        g.advance(frontier, *it);
-      g.advance_known_run(frontier, e.parents, e.span);
+    retreat.clear(); advance_rev.clear();
+    { PROF(diff);
+      retreat_i.clear(); advance_i.clear();
+      z.diff_local(z.last_head, z.lpar.data() + e.pstart, e.np,
+                   retreat_i, advance_i);
+      for (int32_t i : retreat_i)
+        push_reversed_rle(retreat, z.pieces[i].span);
+      for (int32_t i : advance_i)
+        push_reversed_rle(advance_rev, z.pieces[i].span);
     }
+    z.last_head = idx;
 
-    for (int c : e.child_idxs) {
-      if (input[c].visited) continue;
-      bool ok = true;
-      for (int p : input[c].parent_idxs)
-        if (!input[p].visited) { ok = false; break; }
-      if (ok) to_process.push_back(c);
+    for (int32_t k = z.cindptr[idx]; k < z.cindptr[idx + 1]; k++) {
+      int32_t c = z.cflat[k];
+      if (--z.pending[c] == 0 && z.pieces[c].phase == phase)
+        to_process.push_back(c);
     }
     consume = e.span;
     return true;
@@ -1680,10 +1868,18 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
           });
     }
 
-    Tracker tracker;
+    i64 ops_top = 0;
+    if (!c->ops.runs.empty()) {
+      const OpRun& lr = c->ops.runs.back();
+      ops_top = lr.lv + (lr.end - lr.start);
+    }
+    Tracker tracker(ops_top);
+    std::unique_ptr<Zone> zp;
+    { PROF(emit_misc); zp.reset(new Zone(c->g, conflict_ops, new_ops)); }
+    Zone& zone = *zp;
     // build tracker over conflict set
     {
-      Walker w(c->g, conflict_ops, common);
+      Walker w(zone, 0);
       std::vector<Span> retreat, advance_rev;
       Span consume;
       while (w.next(retreat, advance_rev, consume)) {
@@ -1695,7 +1891,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
         emit_ops_range(c, tracker, consume, false);
       }
       // walk new ops
-      Walker w2(c->g, new_ops, w.frontier);
+      Walker w2(zone, 1);
       while (w2.next(retreat, advance_rev, consume)) {
         { PROF(retreat);
           for (const Span& s : retreat) tracker.retreat_by_range(s); }
