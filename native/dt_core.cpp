@@ -22,7 +22,6 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
-#include <map>
 #include <memory>
 #include <queue>
 #include <string>
@@ -741,14 +740,25 @@ struct Tracker {
   std::deque<BNode> node_pool;
   BNode* root;
   BLeaf* first_leaf;
-  SpaceIndex index;
+  // LV -> containing tree leaf, split by range: op LVs are dense in
+  // [0, ops_top) -> O(1) table; underwater placeholder ids (>= 1<<62,
+  // origin-right sentinels and pre-existing text hit by concurrent
+  // deletes) -> small RLE B+ tree. Together they replace the reference's
+  // marker tree InsPtr half (src/listmerge/markers.rs).
+  std::vector<BLeaf*> leaf_of;
+  SpaceIndex uw_index;
   // delete targets: op LVs are dense, so an O(1) run table replaces the
   // reference's marker-tree DelTarget entries (src/listmerge/markers.rs)
   std::vector<DelRow> del_list;
   std::vector<int32_t> del_run_of;  // op lv -> del_list index, -1 = none
 
-  explicit Tracker(i64 ops_top = 0) {
-    del_run_of.assign((size_t)ops_top, -1);
+  // Dense tables cover only [base, ops_top) — the conflict zone's LV
+  // range — so per-merge cost scales with the zone, not the full history.
+  i64 base;
+
+  explicit Tracker(i64 zone_base, i64 ops_top) : base(zone_base) {
+    del_run_of.assign((size_t)(ops_top - base), -1);
+    leaf_of.assign((size_t)(ops_top - base), nullptr);
     leaf_pool.emplace_back();
     node_pool.emplace_back();
     root = &node_pool.back();
@@ -762,7 +772,17 @@ struct Tracker {
     root->raw[0] = UNDERWATER - 1;
     root->cur[0] = UNDERWATER - 1;
     root->up[0] = UNDERWATER - 1;
-    index.set_range(UNDERWATER, UNDERWATER - 1, first_leaf);
+    uw_index.set_range(UNDERWATER, UNDERWATER - 1, first_leaf);
+  }
+
+  inline void set_leaf(i64 ids, i64 len, BLeaf* lf) {
+    if (ids < UNDERWATER) {
+      assert(ids >= base && ids + len - base <= (i64)leaf_of.size());
+      std::fill(leaf_of.begin() + (ids - base),
+                leaf_of.begin() + (ids + len - base), lf);
+    } else {
+      uw_index.set_range(ids, len, lf);
+    }
   }
 
   // ---- aggregate maintenance ----
@@ -875,7 +895,7 @@ struct Tracker {
     par->n++;
     // notify: moved entries now live in rn
     for (int i = 0; i < rn->n; i++)
-      index.set_range(rn->e[i].ids, rn->e[i].len, rn);
+      set_leaf(rn->e[i].ids, rn->e[i].len, rn);
     return rn;
   }
 
@@ -910,7 +930,7 @@ struct Tracker {
     lf->e[idx + 1] = right;
     lf->n++;
     bump(lf, right.len, right.cur(), right.up());
-    if (lf != orig) index.set_range(right.ids, right.len, lf);
+    if (lf != orig) set_leaf(right.ids, right.len, lf);
     return {lf, idx};
   }
 
@@ -932,12 +952,30 @@ struct Tracker {
         return {h, i + 1};
       }
     }
-    BLeaf* lf = index.query(lv);
+    BLeaf* lf;
+    if (lv < UNDERWATER) {
+      assert(lv >= base && lv - base < (i64)leaf_of.size());
+      lf = leaf_of[lv - base];
+    } else {
+      lf = uw_index.query(lv);
+    }
     for (int i = 0; i < lf->n; i++)
       if (lf->e[i].ids <= lv && lv < lf->e[i].ide()) {
         hint_leaf = lf; hint_idx = i;
         return {lf, i};
       }
+#ifdef DT_DEBUG_LOOKUP
+    fprintf(stderr, "ins_lookup MISS lv=%lld mapped=%p\n", (long long)lv, (void*)lf);
+    for (const BLeaf* sl = first_leaf; sl; sl = sl->next)
+      for (int i = 0; i < sl->n; i++)
+        if (sl->e[i].ids <= lv && lv < sl->e[i].ide()) {
+          fprintf(stderr, "  actual leaf=%p idx=%d ids=%lld len=%lld\n",
+                  (void*)sl, i, (long long)sl->e[i].ids, (long long)sl->e[i].len);
+          abort();
+        }
+    fprintf(stderr, "  lv not in ANY leaf\n");
+    abort();
+#endif
     assert(false && "ins_lookup: lv not in mapped leaf");
     return {nullptr, 0};
   }
@@ -1079,7 +1117,7 @@ struct Tracker {
           pv.state != en.state || pv.ever != en.ever) return;
       i64 raw = en.len, cur = en.cur(), up = en.up();
       pv.len += en.len;
-      index.set_range(en.ids, en.len, pl);
+      set_leaf(en.ids, en.len, pl);
       for (int i = 0; i < lf->n - 1; i++) lf->e[i] = lf->e[i + 1];
       lf->n--;
       bump(pl, raw, cur, up);
@@ -1117,11 +1155,11 @@ struct Tracker {
         pv->orr == ent.orr && pv->state == ent.state && pv->ever == ent.ever) {
       pv->len += ent.len;
       bump(pvleaf, ent.len, ent.cur(), ent.up());
-      index.set_range(ent.ids, ent.len, pvleaf);
+      set_leaf(ent.ids, ent.len, pvleaf);
       return;
     }
     auto [l3, i3] = insert_entry(lf, at, ent);
-    index.set_range(ent.ids, ent.len, l3);
+    set_leaf(ent.ids, ent.len, l3);
   }
 
   // `up` is the upstream-length prefix before cursor's entry; threaded
@@ -1270,11 +1308,11 @@ struct Tracker {
       en.ever = true;
       bump(lf, 0, dcur, dup);
 
-      if (op.lv + take <= (i64)del_run_of.size()) {
-        int32_t ri = (int32_t)del_list.size();
-        del_list.push_back(DelRow{op.lv, op.lv + take, t0, t1, fwd});
-        for (i64 v = op.lv; v < op.lv + take; v++) del_run_of[v] = ri;
-      }
+      assert(op.lv >= base &&
+             op.lv + take - base <= (i64)del_run_of.size());
+      int32_t ri = (int32_t)del_list.size();
+      del_list.push_back(DelRow{op.lv, op.lv + take, t0, t1, fwd});
+      for (i64 v = op.lv; v < op.lv + take; v++) del_run_of[v - base] = ri;
       return {take, ever_deleted ? -1 : del_start_xf};
     }
   }
@@ -1284,8 +1322,9 @@ struct Tracker {
   struct QueryRes { u8 kind; i64 t0, t1; bool fwd; i64 offset, total; };
 
   QueryRes index_query(i64 lv) const {
-    if (lv < (i64)del_run_of.size() && del_run_of[lv] >= 0) {
-      const DelRow& r = del_list[del_run_of[lv]];
+    assert(lv >= base && lv - base < (i64)del_run_of.size());
+    if (del_run_of[lv - base] >= 0) {
+      const DelRow& r = del_list[del_run_of[lv - base]];
       return {DEL, r.t0, r.t1, r.fwd, lv - r.lv0, r.lv1 - r.lv0};
     }
     auto [lf, i] = ins_lookup(lv);
@@ -1367,8 +1406,13 @@ struct Tracker {
       assert(lf->n > 0);
       for (int i = 0; i < lf->n; i++) {
         assert(lf->e[i].len > 0);
-        assert(index.query(lf->e[i].ids) == lf);
-        assert(index.query(lf->e[i].ide() - 1) == lf);
+        if (lf->e[i].ids < UNDERWATER) {
+          assert(leaf_of[lf->e[i].ids - base] == lf);
+          assert(leaf_of[lf->e[i].ide() - 1 - base] == lf);
+        } else {
+          assert(uw_index.query(lf->e[i].ids) == lf);
+          assert(uw_index.query(lf->e[i].ide() - 1) == lf);
+        }
       }
     }
   }
@@ -1501,7 +1545,6 @@ struct Zone {
     // 3. collect split points: every parent reference p with p+1 strictly
     //    inside a piece forces a boundary at p+1
     std::vector<i64> cuts;
-    std::vector<i64> ps;
     auto find_proto = [&](i64 v) -> int {
       int lo = 0, hi = (int)protos.size();
       while (lo < hi) {
@@ -1820,9 +1863,8 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
 static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->out.clear();
   std::vector<Span> new_ops, conflict_ops;
-  std::vector<i64> common;
   { PROF(conflict);
-    common = c->g.find_conflicting(
+    c->g.find_conflicting(
         from, merge, [&](Span s, u8 flag) {
           push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
         });
@@ -1862,7 +1904,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   if (!new_ops.empty()) {
     if (did_ff) {
       conflict_ops.clear();
-      common = c->g.find_conflicting(
+      c->g.find_conflicting(
           next_frontier, merge, [&](Span s, u8 flag) {
             if (flag != Graph::OnlyB) push_reversed_rle(conflict_ops, s);
           });
@@ -1873,7 +1915,10 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
       const OpRun& lr = c->ops.runs.back();
       ops_top = lr.lv + (lr.end - lr.start);
     }
-    Tracker tracker(ops_top);
+    i64 zone_base = ops_top;
+    for (const Span& s : conflict_ops) zone_base = std::min(zone_base, s.start);
+    for (const Span& s : new_ops) zone_base = std::min(zone_base, s.start);
+    Tracker tracker(zone_base, ops_top);
     std::unique_ptr<Zone> zp;
     { PROF(emit_misc); zp.reset(new Zone(c->g, conflict_ops, new_ops)); }
     Zone& zone = *zp;
