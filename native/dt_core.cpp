@@ -1509,8 +1509,12 @@ struct Zone {
   std::vector<int32_t> cindptr, cflat;  // children CSR
   std::vector<int32_t> pending;    // unvisited local parent count
   int32_t last_head = -1;          // last consumed piece (shared across phases)
-  // scratch for diff_local
-  std::vector<std::pair<int32_t, u8>> heap;
+  // scratch for diff_local: active bitmap + per-piece flag; each piece
+  // enters the working set at most once (parents always have lower idx),
+  // flags combine in place instead of queueing duplicates.
+  std::vector<uint64_t> abits;
+  std::vector<u8> aflag;
+  std::vector<int32_t> touched;
 
   // a, b: descending span lists (phase 0 / phase 1)
   Zone(const Graph& g, const std::vector<Span>& conflict,
@@ -1634,6 +1638,8 @@ struct Zone {
     }
     pending.resize(pieces.size());
     for (size_t i = 0; i < pieces.size(); i++) pending[i] = pieces[i].np;
+    abits.assign((pieces.size() + 63) / 64, 0);
+    aflag.assign(pieces.size(), 0);
   }
 
   // diff between head closure and parents closure, over local idxs.
@@ -1649,34 +1655,42 @@ struct Zone {
     if (np == 1 && par[0] == head) g_walk_zero++;
 #endif
     if (np == 1 && par[0] == head) return;  // zero-churn chain step
-    heap.clear();
-    if (head >= 0) heap.push_back({head, A});
-    for (int32_t k = 0; k < np; k++) heap.push_back({par[k], B});
-    std::make_heap(heap.begin(), heap.end());
-    long num_shared = 0;
-    while (!heap.empty()) {
+    int hi_word = -1;
+    long nonshared = 0;
+    touched.clear();
+    auto bit_push = [&](int32_t idx, u8 flag) {
+      int w = idx >> 6;
+      uint64_t m = 1ull << (idx & 63);
+      if (abits[w] & m) {
+        u8 old = aflag[idx];
+        if (old != Shared && old != flag) { aflag[idx] = Shared; nonshared--; }
+      } else {
+        abits[w] |= m;
+        aflag[idx] = flag;
+        touched.push_back(idx);
+        if (flag != Shared) nonshared++;
+        if (w > hi_word) hi_word = w;
+      }
+    };
+    if (head >= 0) bit_push(head, A);
+    for (int32_t k = 0; k < np; k++) bit_push(par[k], B);
+    while (nonshared > 0) {
 #ifdef DT_PROF
       g_diff_iters2++;
 #endif
-      auto [idx, flag] = heap.front();
-      std::pop_heap(heap.begin(), heap.end()); heap.pop_back();
-      if (flag == Shared) num_shared--;
-      while (!heap.empty() && heap.front().first == idx) {
-        u8 pf = heap.front().second;
-        std::pop_heap(heap.begin(), heap.end()); heap.pop_back();
-        if (pf != flag) flag = Shared;
-        if (pf == Shared) num_shared--;
-      }
+      while (abits[hi_word] == 0) hi_word--;
+      int b = 63 - __builtin_clzll(abits[hi_word]);
+      int32_t idx = (int32_t)((hi_word << 6) | b);
+      abits[hi_word] &= ~(1ull << b);
+      u8 flag = aflag[idx];
+      if (flag != Shared) nonshared--;
       if (flag == A) retreat_i.push_back(idx);
       else if (flag == B) advance_i.push_back(idx);
       const Piece& p = pieces[idx];
-      for (int32_t k = 0; k < p.np; k++) {
-        heap.push_back({lpar[p.pstart + k], flag});
-        std::push_heap(heap.begin(), heap.end());
-        if (flag == Shared) num_shared++;
-      }
-      if ((long)heap.size() == num_shared) break;
+      for (int32_t k = 0; k < p.np; k++) bit_push(lpar[p.pstart + k], flag);
     }
+    // clear any bits left set by the early (all-Shared) exit
+    for (int32_t idx : touched) abits[idx >> 6] &= ~(1ull << (idx & 63));
   }
 };
 
