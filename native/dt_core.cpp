@@ -468,7 +468,7 @@ struct BEntry {
   }
 };
 
-static const int LEAF_CAP = 32;   // entries per leaf
+static const int LEAF_CAP = 16;   // entries per leaf
 static const int NODE_CAP = 16;   // children per internal node
 
 struct BNode;
@@ -751,6 +751,16 @@ struct Tracker {
   // reference's marker-tree DelTarget entries (src/listmerge/markers.rs)
   std::vector<DelRow> del_list;
   std::vector<int32_t> del_run_of;  // op lv -> del_list index, -1 = none
+
+  // Forward-delete continuation memo: a long delete run is applied in
+  // entry-bounded chunks with an unchanged current position (the text
+  // shifts left under it, Ops::slice keeps .start fixed for fwd deletes).
+  // After a partial chunk we stash the rolled-forward cursor + upstream
+  // prefix so the continuation call skips the root descent. Invalidated by
+  // any other tree mutation (inserts, toggles, reverse deletes).
+  i64 del_cont_pos = -1;
+  i64 del_cont_up = 0;
+  Cursor del_cont_cursor{nullptr, 0, 0};
 
   // Dense tables cover only [base, ops_top) — the conflict zone's LV
   // range — so per-merge cost scales with the zone, not the full history.
@@ -1242,6 +1252,7 @@ struct Tracker {
                             i64 max_len) {
     i64 length = std::min(max_len, op.end - op.start);
     if (op.kind == INS) {
+      del_cont_pos = -1;
       assert(op.fwd && "reverse insert runs unsupported");
       i64 origin_left;
       Cursor cursor;
@@ -1274,7 +1285,12 @@ struct Tracker {
       i64 take_req;
       i64 up_prefix = 0;
       if (fwd) {
-        cursor = find_by_cur(op.start, &up_prefix);
+        if (op.start == del_cont_pos) {
+          cursor = del_cont_cursor;
+          up_prefix = del_cont_up;
+        } else {
+          cursor = find_by_cur(op.start, &up_prefix);
+        }
         take_req = length;
       } else {
         i64 last_pos = op.end - 1;
@@ -1313,6 +1329,24 @@ struct Tracker {
       int32_t ri = (int32_t)del_list.size();
       del_list.push_back(DelRow{op.lv, op.lv + take, t0, t1, fwd});
       for (i64 v = op.lv; v < op.lv + take; v++) del_run_of[v - base] = ri;
+      del_cont_pos = -1;
+      if (fwd && take < take_req) {
+        // roll to the next current entry for the continuation chunk,
+        // folding crossed entries into the upstream prefix (left split
+        // half contributes its pre-delete up(), the target now 0)
+        i64 up2 = up_prefix + (ever_deleted ? 0 : off);
+        Cursor c{lf, idx, 0};
+        while (next_entry(c)) {
+          const BEntry& ne = c.leaf->e[c.idx];
+          if (ne.state == 1) break;
+          up2 += ne.up();
+        }
+        if (c.leaf) {
+          del_cont_cursor = c;
+          del_cont_up = up2;
+          del_cont_pos = op.start;
+        }
+      }
       return {take, ever_deleted ? -1 : del_start_xf};
     }
   }
@@ -1340,6 +1374,7 @@ struct Tracker {
 
   void toggle_items(i64 s, i64 e, int mode) {
     // modes: 0 ins, 1 unins, 2 del, 3 undel
+    del_cont_pos = -1;
     i64 lv = s;
     while (lv < e) {
       auto [lf, idx] = ins_lookup(lv);
@@ -1759,28 +1794,27 @@ struct XfOp { i64 lv; i64 len; u8 kind; u8 fwd; i64 pos; };  // pos=-1 => gone
 // diamond_types_tpu/utils/rope.py).
 struct TextBuf {
   static const size_t TARGET = 2048;
+  static const size_t GROUP = 64;  // chunks per group-sum slot
   std::vector<std::vector<int32_t>> chunks;
-  std::vector<i64> cum;  // chars before chunk i; size chunks.size()+1
-  bool dirty = true;
+  std::vector<i64> sizes;  // parallel to chunks
+  std::vector<i64> gsum;   // per-group char totals (incremental index)
   i64 total = 0;
 
-  TextBuf() { chunks.emplace_back(); }
+  TextBuf() { chunks.emplace_back(); sizes.push_back(0); gsum.push_back(0); }
 
-  void rebuild() {
-    cum.resize(chunks.size() + 1);
-    cum[0] = 0;
-    for (size_t i = 0; i < chunks.size(); i++)
-      cum[i + 1] = cum[i] + (i64)chunks[i].size();
-    dirty = false;
+  // O(#chunks); only needed when chunks are added/removed (split, erase)
+  void rebuild_groups() {
+    gsum.assign((chunks.size() + GROUP - 1) / GROUP, 0);
+    for (size_t i = 0; i < chunks.size(); i++) gsum[i / GROUP] += sizes[i];
   }
 
-  std::pair<size_t, i64> find(i64 pos) {
-    if (dirty) rebuild();
-    size_t lo = 0, hi = chunks.size();
-    while (lo < hi) { size_t mid = (lo + hi) / 2;
-      if (cum[mid + 1] <= pos) lo = mid + 1; else hi = mid; }
-    if (lo >= chunks.size()) { lo = chunks.size() - 1; }
-    return {lo, pos - cum[lo]};
+  std::pair<size_t, i64> find(i64 pos) const {
+    size_t g = 0;
+    while (g + 1 < gsum.size() && pos >= gsum[g]) { pos -= gsum[g]; g++; }
+    size_t i = g * GROUP;
+    size_t end = std::min(chunks.size(), (g + 1) * GROUP);
+    while (i + 1 < end && pos >= sizes[i]) { pos -= sizes[i]; i++; }
+    return {i, pos};
   }
 
   void insert(i64 pos, const int32_t* s, i64 n) {
@@ -1788,6 +1822,8 @@ struct TextBuf {
     auto [ci, off] = find(pos);
     auto& ch = chunks[ci];
     ch.insert(ch.begin() + off, s, s + n);
+    sizes[ci] += n;
+    gsum[ci / GROUP] += n;
     total += n;
     if (ch.size() > 2 * TARGET) {
       // split into TARGET-sized chunks
@@ -1796,25 +1832,39 @@ struct TextBuf {
         parts.emplace_back(ch.begin() + i,
                            ch.begin() + std::min(ch.size(), i + TARGET));
       chunks.erase(chunks.begin() + ci);
-      chunks.insert(chunks.begin() + ci, parts.begin(), parts.end());
+      sizes.erase(sizes.begin() + ci);
+      sizes.insert(sizes.begin() + ci, parts.size(), 0);
+      for (size_t i = 0; i < parts.size(); i++)
+        sizes[ci + i] = (i64)parts[i].size();
+      chunks.insert(chunks.begin() + ci,
+                    std::make_move_iterator(parts.begin()),
+                    std::make_move_iterator(parts.end()));
+      rebuild_groups();
     }
-    dirty = true;
   }
 
   void erase(i64 pos, i64 n) {
     if (n <= 0) return;
     total -= n;
     auto [ci, off] = find(pos);
+    bool removed = false;
     while (n > 0) {
       auto& ch = chunks[ci];
       i64 take = std::min((i64)ch.size() - off, n);
       ch.erase(ch.begin() + off, ch.begin() + off + take);
+      sizes[ci] -= take;
+      if (!removed) gsum[ci / GROUP] -= take;
       n -= take;
-      if (ch.empty() && chunks.size() > 1) chunks.erase(chunks.begin() + ci);
-      else ci++;
+      if (ch.empty() && chunks.size() > 1) {
+        chunks.erase(chunks.begin() + ci);
+        sizes.erase(sizes.begin() + ci);
+        removed = true;
+      } else {
+        ci++;
+      }
       off = 0;
     }
-    dirty = true;
+    if (removed) rebuild_groups();
   }
 
   void dump(int32_t* out) const {
@@ -1849,12 +1899,16 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
     i64 o0 = pos - run.lv;
     i64 o1 = std::min(consume.end, run_end) - run.lv;
     OpRun piece = Ops::slice(run, o0, o1);
-    // apply in chunks bounded by agent runs
+    // apply in chunks bounded by agent runs; the agent lookup is hoisted
+    // across entry-bounded chunks of the same run (alen counts down)
+    i64 agent = -1, alen = 0;
     while (true) {
       i64 plen = piece.end - piece.start;
-      i64 agent, seq;
-      c->aa.local_to_agent(piece.lv, agent, seq);
-      i64 alen = c->aa.span_len(piece.lv, plen);
+      if (alen <= 0) {
+        i64 seq;
+        c->aa.local_to_agent(piece.lv, agent, seq);
+        alen = c->aa.span_len(piece.lv, plen);
+      }
       std::pair<i64,i64> r;
       if (piece.kind == INS) { PROF(apply_ins); r = tracker.apply(c->aa, agent, piece, alen); }
       else { PROF(apply_del); r = tracker.apply(c->aa, agent, piece, alen); }
@@ -1866,6 +1920,7 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
 #endif
       if (emit)
         c->out.push_back({piece.lv, consumed, piece.kind, piece.fwd, xf});
+      alen -= consumed;
       if (consumed == plen) break;
       piece = Ops::slice(piece, consumed, plen);
     }
