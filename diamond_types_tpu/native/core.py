@@ -12,6 +12,11 @@ from .build import OUT as _SO_PATH, build as _build
 
 _lib = None
 
+# Underwater sentinel base (ids at or above this are pre-zone placeholder
+# text, not real op LVs) — one definition, shared with native/dt_core.cpp's
+# UNDERWATER constant.
+from ..core.span import UNDERWATER_START as UNDERWATER  # noqa: E402
+
 
 def _load():
     global _lib
@@ -60,6 +65,18 @@ def _load():
     lib.dt_get_out_frontier.argtypes = [
         ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
     lib.dt_get_out_frontier.restype = ct.c_int64
+    lib.dt_dump_tracker.argtypes = [
+        ct.c_void_p, ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C")]
+    lib.dt_dump_tracker.restype = ct.c_int64
+    lib.dt_get_zone_common.argtypes = [
+        ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
+    lib.dt_get_zone_common.restype = ct.c_int64
     _lib = lib
     return lib
 
@@ -160,6 +177,40 @@ class NativeContext:
         return lv, ln, kind, fwd, pos, frontier
 
 
+    def zone_common(self):
+        """Common-ancestor frontier of the last transform's conflict zone
+        (the version whose document the underwater id space tiles)."""
+        lib = self._lib
+        buf = np.empty(64, dtype=np.int64)
+        k = lib.dt_get_zone_common(self._ptr, buf, 64)
+        if k > 64:
+            buf = np.empty(k, dtype=np.int64)
+            lib.dt_get_zone_common(self._ptr, buf, k)
+        return [int(x) for x in buf[:k]]
+
+    def dump_tracker(self, keep_underwater: bool = False):
+        """Item table of the last transform's tracker, in DOCUMENT order:
+        (ids, len, origin_left, origin_right, state, ever) arrays.
+        Underwater sentinel rows (ids >= 1<<62) are the pre-zone document
+        text (anchor targets for zone items); filtered unless requested."""
+        lib = self._lib
+        z = np.zeros(0, dtype=np.int64)
+        zu = np.zeros(0, dtype=np.uint8)
+        n = lib.dt_dump_tracker(self._ptr, 0, z, z, z, z, z, zu)
+        ids = np.empty(n, dtype=np.int64)
+        ln = np.empty(n, dtype=np.int64)
+        ol = np.empty(n, dtype=np.int64)
+        orr = np.empty(n, dtype=np.int64)
+        st = np.empty(n, dtype=np.int64)
+        ev = np.empty(n, dtype=np.uint8)
+        if n:
+            lib.dt_dump_tracker(self._ptr, n, ids, ln, ol, orr, st, ev)
+        if not keep_underwater:
+            keep = ids < UNDERWATER
+            return (ids[keep], ln[keep], ol[keep], orr[keep], st[keep],
+                    ev[keep])
+        return (ids, ln, ol, orr, st, ev)
+
     def merge_to_string(self, init: str, from_frontier: Sequence[int],
                         merge_frontier: Sequence[int]):
         """Full native merge: returns (final_doc_str, final_frontier)."""
@@ -183,17 +234,19 @@ class NativeContext:
         return doc, [int(x) for x in fbuf[:k]]
 
 
-def merge_native(oplog, init: str, from_frontier, merge_frontier):
+def get_native_ctx(oplog) -> "NativeContext":
+    """The oplog's cached NativeContext (created on first use)."""
     ctx = getattr(oplog, "_native_ctx", None)
     if ctx is None:
         ctx = NativeContext(oplog)
         oplog._native_ctx = ctx
-    return ctx.merge_to_string(init, from_frontier, merge_frontier)
+    return ctx
+
+
+def merge_native(oplog, init: str, from_frontier, merge_frontier):
+    return get_native_ctx(oplog).merge_to_string(init, from_frontier,
+                                                 merge_frontier)
 
 
 def transform_native(oplog, from_frontier, merge_frontier):
-    ctx = getattr(oplog, "_native_ctx", None)
-    if ctx is None:
-        ctx = NativeContext(oplog)
-        oplog._native_ctx = ctx
-    return ctx.transform(from_frontier, merge_frontier)
+    return get_native_ctx(oplog).transform(from_frontier, merge_frontier)

@@ -1,0 +1,360 @@
+"""Fugue-tree linearization — the parallel formulation of YjsMod integrate.
+
+The reference resolves concurrent-insert order with a sequential scan per
+insert (reference: src/listmerge/merge.rs:154-278 `integrate`, the YjsMod /
+FugueMax algorithm). That scan is the part of the merge engine a TPU cannot
+express directly: it is data-dependent, early-exiting control flow.
+
+This module re-expresses the SAME total order as a static tree computation
+(the Fugue construction: every item becomes a left child of its right
+origin or a right child of its left origin; the document is the DFS of
+that tree). Tree construction, sibling ordering, and the DFS linearization
+are all sorts + segment scans — exactly the shapes XLA runs well — so the
+whole-history merge order for thousands of concurrent items is computed in
+a handful of parallel primitives instead of one scan per item.
+
+Inputs are RLE runs (id-consecutive items sharing origins/state, the
+tracker's native granularity):
+
+    ids[i]   first LV of run i  (underwater ids >= 1<<62 are pre-zone text)
+    length[i] run length (items)
+    ol[i]    origin-left:  LV of the item immediately left at insert time,
+             or -1 (document start)
+    orr[i]   origin-right: LV of the next item at-or-right at insert time,
+             or -1 (document end)
+    agent[i] tie-break rank of the inserting agent — rank of the agent's
+             NAME in sorted order (reference tie-breaks by name:
+             agent_assignment/mod.rs:163 tie_break_agent_versions)
+    seq[i]   agent-local sequence number of the run's first item
+
+The host supplies origins (extracted by the tracker during its walk — the
+"CPU-side position index stays host-side" split from BASELINE.json); this
+module owns everything after that point.
+
+Validation: `tests/test_linearize.py` checks the produced order is
+IDENTICAL to the native tracker's document order (dt_dump_tracker) on the
+shipped corpora and on randomized concurrent fuzz documents.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..native.core import UNDERWATER
+
+ROOT = -1
+
+# ---------------------------------------------------------------------------
+# host-side preparation: split runs so every anchor is a run endpoint
+# ---------------------------------------------------------------------------
+
+
+def split_runs_at_anchors(ids: np.ndarray, length: np.ndarray,
+                          ol: np.ndarray, orr: np.ndarray,
+                          extra: Tuple[np.ndarray, ...] = ()
+                          ) -> Tuple[np.ndarray, ...]:
+    """Split RLE runs so that every origin-left lands on a run's LAST item
+    and every origin-right on a run's FIRST item. After this pass the tree
+    is a pure run-level structure (no intra-run anchors).
+
+    `extra` arrays (e.g. state) are split alongside; items inside a run are
+    id-consecutive so a split at offset k gives (ids, k) + (ids+k, len-k)
+    with the right half chained: ol = ids+k-1, orr = original orr... the
+    right half keeps the SAME orr only if it was the run's trailing part;
+    mid-run items' effective right origin within a run is the next item of
+    the run itself, which stays adjacent — the chain ol encodes that.
+    """
+    ends = ids + length
+    # cut points: after every referenced ol (ol+1), and at every orr
+    cuts = np.concatenate([ol[ol != ROOT] + 1, orr[orr != ROOT]])
+    cuts = np.unique(cuts)
+    # map each cut to the run containing it strictly inside (start < cut < end)
+    order = np.argsort(ids, kind="stable")
+    sids = ids[order]
+    run_of = np.searchsorted(sids, cuts, side="right") - 1
+    valid = (run_of >= 0)
+    run_of = np.clip(run_of, 0, len(sids) - 1)
+    inside = valid & (cuts > sids[run_of]) & (cuts < (sids + length[order])[run_of])
+    cuts = cuts[inside]
+    run_idx = order[run_of[inside]]  # original index of run to split
+
+    # vectorized piece emission, grouped by run (ascending), cuts
+    # ascending within each run
+    n = len(ids)
+    counts = np.bincount(run_idx, minlength=n) + 1
+    out_n = int(counts.sum())
+    offs = np.cumsum(counts) - counts          # first piece of each run
+    last = offs + counts - 1                   # last piece of each run
+    run_of_piece = np.repeat(np.arange(n), counts)
+
+    cut_order = np.lexsort((cuts, run_idx))
+    cuts_sorted = cuts[cut_order]
+
+    is_first = np.zeros(out_n, dtype=bool)
+    is_first[offs] = True
+    new_ids = np.empty(out_n, dtype=np.int64)
+    new_ids[offs] = ids
+    new_ids[~is_first] = cuts_sorted           # (run, cut) order matches
+    new_end = np.empty(out_n, dtype=np.int64)
+    if out_n > 1:
+        new_end[:-1] = new_ids[1:]             # next piece's start...
+    new_end[last] = ends                       # ...except at run ends
+    new_len = new_end - new_ids
+    new_ol = np.where(is_first, ol[run_of_piece], new_ids - 1)
+    new_orr = orr[run_of_piece]
+    new_extra = tuple(e[run_of_piece] for e in extra)
+    return (new_ids, new_len, new_ol, new_orr) + new_extra
+
+
+# ---------------------------------------------------------------------------
+# numpy reference linearizer
+# ---------------------------------------------------------------------------
+
+
+def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
+                   orr: np.ndarray, agent: np.ndarray, seq: np.ndarray
+                   ) -> np.ndarray:
+    """Return the permutation of run indices giving document order.
+
+    Precondition: runs are anchor-split (split_runs_at_anchors) — every ol
+    is some run's last item, every orr some run's first item.
+
+    Tree rules (empirically validated == YjsMod; see module docstring):
+      * parent/side: run x is a LEFT child of the run starting at orr(x)
+        when that run shares x's left origin (same insertion gap — the
+        "b.leftOrigin == a" Fugue condition); otherwise x is a RIGHT child
+        of the run whose last item is ol(x) (ol == ROOT → right child of
+        the virtual root).
+      * RIGHT children of the same parent sort by the YjsMod sibling order:
+        right-origin position DESCENDING, then (agent rank, seq) ascending.
+        LEFT children likewise.
+    The right-origin "position" ordering is resolved structurally: after
+    anchor splitting, two same-gap siblings with different right origins
+    are routed to different parents (the one anchored on the nearer orr
+    becomes that run's left child), so same-(parent, side) siblings with
+    different orr can only be compared through tree depth — the sort key
+    falls back to (agent, seq) exactly when orr ties.
+    """
+    n = len(ids)
+    ends = ids + length
+    # run lookup tables
+    start_of = {int(v): i for i, v in enumerate(ids)}
+    end_of = {int(e) - 1: i for i, e in enumerate(ends)}
+
+    def run_starting(lv):
+        return start_of.get(int(lv), -2)
+
+    def run_ending(lv):
+        return end_of.get(int(lv), -2)
+
+    parent = np.full(n, -1, dtype=np.int64)   # -1 = virtual root
+    side = np.zeros(n, dtype=np.int8)         # 0 = left child, 1 = right
+
+    for i in range(n):
+        if ids[i] >= UNDERWATER:
+            # pre-zone text: fixed spine, right children of the root in id
+            # order (underwater ids ascend with document position)
+            parent[i] = -1
+            side[i] = 1
+            continue
+        r = run_starting(orr[i]) if orr[i] != ROOT else -2
+        if r >= 0 and ol[r] == ol[i]:
+            parent[i] = r
+            side[i] = 0
+        else:
+            if ol[i] == ROOT:
+                parent[i] = -1
+                side[i] = 1
+            else:
+                p = run_ending(ol[i])
+                assert p >= 0, f"unsplit ol anchor {ol[i]}"
+                parent[i] = p
+                side[i] = 1
+
+    # sibling sort keys
+    # underwater runs order by id among root's right children, ahead of
+    # nothing special — real items at the root compare by (agent, seq)
+    uw = ids >= UNDERWATER
+    key_agent = np.where(uw, -1, agent)
+    uw_sorted = np.sort(ids[uw])
+    key_seq = np.where(uw, np.searchsorted(uw_sorted, ids), seq)
+
+    order = np.lexsort((key_seq, key_agent, side, parent))
+
+    # children lists
+    from collections import defaultdict
+    kids_left = defaultdict(list)
+    kids_right = defaultdict(list)
+    for i in order:
+        (kids_left if side[i] == 0 else kids_right)[int(parent[i])].append(i)
+
+    out = np.empty(n, dtype=np.int64)
+    w = 0
+    # iterative DFS: (node, phase) — phase 0 = emit left kids, 1 = self+right
+    stack = [(-1, 0)]
+    while stack:
+        node, phase = stack.pop()
+        if phase == 0:
+            stack.append((node, 1))
+            for c in reversed(kids_left.get(node, ())):
+                stack.append((c, 0))
+        else:
+            if node >= 0:
+                out[w] = node
+                w += 1
+            for c in reversed(kids_right.get(node, ())):
+                stack.append((c, 0))
+    assert w == n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-side tree construction (vectorized; feeds the device kernel)
+# ---------------------------------------------------------------------------
+
+
+def build_tree_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
+                  orr: np.ndarray, agent: np.ndarray, seq: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized parent/side/key computation for anchor-split runs.
+
+    Returns (parent, side, key_agent, key_seq); parent == n means the
+    virtual root (index n)."""
+    n = len(ids)
+    ends = ids + length
+    order_s = np.argsort(ids, kind="stable")
+    sorted_starts = ids[order_s]
+    order_e = np.argsort(ends, kind="stable")
+    sorted_ends = ends[order_e]
+
+    def run_starting(lv):
+        j = np.searchsorted(sorted_starts, lv)
+        jj = np.clip(j, 0, n - 1)
+        hit = (j < n) & (sorted_starts[jj] == lv)
+        return np.where(hit, order_s[jj], -2)
+
+    def run_ending(lv):
+        j = np.searchsorted(sorted_ends, lv + 1)
+        jj = np.clip(j, 0, n - 1)
+        hit = (j < n) & (sorted_ends[jj] == lv + 1)
+        return np.where(hit, order_e[jj], -2)
+
+    uw = ids >= UNDERWATER
+    r = np.where(orr != ROOT, run_starting(orr), -2)
+    r_ok = (r >= 0) & (ol[np.clip(r, 0, n - 1)] == ol) & ~uw
+    p_right = np.where(ol == ROOT, n, run_ending(ol))
+    parent = np.where(uw, n, np.where(r_ok, r, p_right)).astype(np.int64)
+    side = np.where(uw, 1, np.where(r_ok, 0, 1)).astype(np.int8)
+    key_agent = np.where(uw, -1, agent).astype(np.int64)
+    # underwater sort key: RANK among underwater ids (their absolute ids
+    # exceed int32; only the relative order matters — ids ascend with
+    # document position)
+    uw_sorted = np.sort(ids[uw])
+    uw_rank = np.searchsorted(uw_sorted, ids)
+    key_seq = np.where(uw, uw_rank, seq).astype(np.int64)
+    # the device kernel runs in int32: keys must fit (seq/agent counts do
+    # for any real oplog; fail loudly rather than silently mis-sorting)
+    assert key_seq.max(initial=0) < 2**31 and key_agent.max(initial=0) < 2**31
+    assert (parent >= 0).all(), "unsplit anchor"
+    return parent, side, key_agent, key_seq
+
+
+# ---------------------------------------------------------------------------
+# device linearizer (JAX): sibling sort + threaded tour + list ranking
+# ---------------------------------------------------------------------------
+
+
+def fugue_linearize_jax(parent, side, key_agent, key_seq):
+    """Document-order permutation of n tree nodes on device.
+
+    All inputs are int arrays of length n (parent == n denotes the virtual
+    root). Returns perm [n]: node indices in document order. Padding nodes
+    should carry parent == n, side == 1, key_agent == INT_MAX-ish so they
+    sort to the end of the document.
+
+    Pure sorts/gathers/scans — no data-dependent control flow. The DFS is
+    computed via a threaded Euler tour (3 cells per node: pre, visit,
+    post) ranked by pointer jumping in ceil(log2(3n+3)) rounds.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = parent.shape[0]
+    root = n
+
+    # sibling order: (parent, side, key_agent, key_seq)
+    sort_idx = jnp.lexsort((key_seq, key_agent, side.astype(jnp.int32),
+                            parent))
+    p_s = parent[sort_idx]
+    s_s = side[sort_idx].astype(jnp.int32)
+    grp = p_s * 2 + s_s
+    # next sibling within the group; -1 at group end
+    nxt = jnp.where(
+        (jnp.arange(n) < n - 1) & (grp == jnp.roll(grp, -1)),
+        jnp.roll(sort_idx, -1), -1)
+    next_sib = jnp.zeros(n, dtype=jnp.int32).at[sort_idx].set(nxt)
+    # first child per (node, side) via group-head scatter; non-heads are
+    # routed to a dedicated overflow slot so no real slot gets clobbered
+    is_head = jnp.concatenate([jnp.array([True]),
+                               grp[1:] != grp[:-1]]) if n else jnp.zeros(0, bool)
+    first = jnp.full(((n + 1) * 2 + 1,), -1, dtype=jnp.int32)
+    first = first.at[jnp.where(is_head, grp, (n + 1) * 2)].set(
+        jnp.where(is_head, sort_idx, -1), mode="drop")
+    first_left = first[jnp.arange(n + 1) * 2]
+    first_right = first[jnp.arange(n + 1) * 2 + 1]
+
+    # cells: pre(x)=x, visit(x)=N+x, post(x)=2N+x for x in 0..n (incl root)
+    N = n + 1
+    idx = jnp.arange(N)
+    succ_pre = jnp.where(first_left >= 0, first_left, N + idx)
+    succ_visit = jnp.where(first_right >= 0, first_right, 2 * N + idx)
+    # post(c): next sibling's pre, else visit(parent) [left] / post(parent)
+    parent_full = jnp.concatenate(
+        [parent, jnp.array([root], dtype=parent.dtype)])
+    side_full = jnp.concatenate(
+        [side.astype(jnp.int32), jnp.array([1], dtype=jnp.int32)])
+    next_sib_full = jnp.concatenate(
+        [next_sib, jnp.array([-1], dtype=jnp.int32)])
+    up = jnp.where(side_full == 0, N + parent_full, 2 * N + parent_full)
+    succ_post = jnp.where(next_sib_full >= 0, next_sib_full, up)
+    succ_post = succ_post.at[root].set(-1)  # end of tour
+    succ = jnp.concatenate([succ_pre, succ_visit, succ_post])
+
+    # list ranking by pointer jumping: dist = #cells strictly after me
+    dist = jnp.where(succ >= 0, 1, 0)
+    n_rounds = max(1, int(np.ceil(np.log2(3 * N))) + 1)
+
+    def body(_, carry):
+        dist, succ = carry
+        sc = jnp.clip(succ, 0, 3 * N - 1)
+        dist2 = dist + jnp.where(succ >= 0, dist[sc], 0)
+        succ2 = jnp.where(succ >= 0, succ[sc], -1)
+        return dist2, succ2
+
+    dist, _ = lax.fori_loop(0, n_rounds, body, (dist, succ))
+    # visit-cell position from head = total - 1 - dist
+    visit_rank = (3 * N - 1) - dist[N:N + n]  # item nodes only (root excl.)
+    return jnp.argsort(visit_rank)
+
+
+def materialize_jax(perm, vis_len, arena_off, arena, cap: int):
+    """Assemble the visible document text on device.
+
+    perm [n]: document-order permutation; vis_len [n]: visible char count
+    of each run (0 for deleted/NIY/padding); arena_off [n]: first char of
+    the run's content in `arena` (int32 char codes); cap: static output
+    size. Returns (text [cap] int32, total_len)."""
+    import jax.numpy as jnp
+
+    vl = vis_len[perm]
+    cum = jnp.cumsum(vl)
+    total = cum[-1] if vl.shape[0] else jnp.int64(0)
+    starts = cum - vl
+    j = jnp.arange(cap)
+    r = jnp.searchsorted(cum, j, side="right")
+    rc = jnp.clip(r, 0, vl.shape[0] - 1)
+    src = arena_off[perm][rc] + (j - starts[rc])
+    text = arena[jnp.clip(src, 0, arena.shape[0] - 1)]
+    return jnp.where(j < total, text, 0), total

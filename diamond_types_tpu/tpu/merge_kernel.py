@@ -1,0 +1,228 @@
+"""listmerge_tpu — the device-resident merge backend.
+
+End-to-end document checkout with the concurrent-order resolution running
+on the accelerator (reference equivalent: the whole `src/listmerge` stack).
+Division of labor (BASELINE.json north star): the host extracts per-item
+origins (its order-statistic tree is the right tool for positional
+lookups); the device computes the global document order — the Fugue-tree
+linearization that replaces YjsMod `integrate` (see tpu/linearize.py) —
+plus visibility filtering and text assembly, batched over documents.
+
+Pipeline:
+
+  host   prepare_doc(oplog):
+           native transform (origin extraction) -> tracker item table
+           -> anchor-split runs -> tree arrays (parent/side/keys)
+           -> char pool (fast-forward prefix text + insert arena slices)
+  device checkout_device / checkout_batch_device:
+           fugue_linearize_jax (sorts + pointer-jumping Euler tour)
+           -> visible-length prefix sums -> gather from the char pool
+
+Batching: documents are padded to a common run count and char capacity and
+vmapped; padding runs carry parent=root, huge sort keys, and zero visible
+length, so they sort to the end and contribute no text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+
+from .linearize import (ROOT, UNDERWATER, build_tree_np,
+                        fugue_linearize_jax, materialize_jax,
+                        split_runs_at_anchors)
+
+
+@dataclass
+class DeviceDoc:
+    """Host-prepared dense tables for one document's device checkout."""
+    parent: np.ndarray      # [n] int32, parent == n -> virtual root
+    side: np.ndarray        # [n] int8, 0 left / 1 right child
+    key_agent: np.ndarray   # [n] int32 sibling sort key (agent name rank)
+    key_seq: np.ndarray     # [n] int32 sibling sort key (seq)
+    vis_len: np.ndarray     # [n] int32 visible chars contributed by run
+    char_off: np.ndarray    # [n] int32 first char of run in `chars`
+    chars: np.ndarray       # [pool] int32 char codes (prefix + ins arena)
+    total_len: int          # expected document length
+
+
+def _agent_keys(oplog, lvs: np.ndarray):
+    """(name-rank, seq) per LV, vectorized over the agent-assignment runs.
+
+    Reference tie-break: agent NAME order then seq
+    (causalgraph/agent_assignment/mod.rs:163)."""
+    aa = oplog.cg.agent_assignment
+    gr = aa.global_runs
+    lv0 = np.asarray([r[0] for r in gr], dtype=np.int64)
+    ag = np.asarray([r[2] for r in gr], dtype=np.int64)
+    sq0 = np.asarray([r[3] for r in gr], dtype=np.int64)
+    o = np.argsort(lv0)
+    lv0, ag, sq0 = lv0[o], ag[o], sq0[o]
+    name_rank = np.asarray(np.argsort(np.argsort(aa.agent_names)))
+    j = np.clip(np.searchsorted(lv0, lvs, side="right") - 1, 0, len(lv0) - 1)
+    agent = np.where(lvs >= UNDERWATER, 0, name_rank[ag[j]])
+    seq = np.where(lvs >= UNDERWATER, 0, sq0[j] + (lvs - lv0[j]))
+    return agent, seq
+
+
+def _arena_offsets(oplog, lvs: np.ndarray) -> np.ndarray:
+    """Insert-arena char offset of each LV (must be insert LVs)."""
+    from ..text.op import INS
+    runs = oplog.ops.runs
+    lv0 = np.asarray([r.lv for r in runs], dtype=np.int64)
+    cp0 = np.asarray(
+        [r.content_pos[0] if (r.kind == INS and r.content_pos is not None)
+         else -1 for r in runs], dtype=np.int64)
+    j = np.clip(np.searchsorted(lv0, lvs, side="right") - 1, 0, len(lv0) - 1)
+    return cp0[j] + (lvs - lv0[j])
+
+
+def prepare_doc(oplog) -> DeviceDoc:
+    """Host pass: origins + char pool for a full checkout (from scratch)."""
+    from ..native.core import get_native_ctx
+
+    ctx = get_native_ctx(oplog)
+    merge = [int(x) for x in oplog.version]
+    ctx.transform([], merge)
+    ids, ln, ol, orr, st, ev = ctx.dump_tracker(keep_underwater=True)
+    common = ctx.zone_common()
+
+    # The underwater id space tiles the document at the conflict zone's
+    # COMMON ANCESTOR (the version the tracker's walk starts from) — NOT
+    # at [min insert id - 1]: zone ops that are pure deletes toggle
+    # underwater text without creating tracker items.
+    if len(ids) == 0:
+        # no conflict zone at all (purely linear history): the document is
+        # the fast-forward result; model it as one visible pseudo-run
+        prefix, _ = ctx.merge_to_string("", [], merge)
+        arr = np.frombuffer(prefix.encode("utf-32-le"), dtype=np.int32)
+        n = 1
+        return DeviceDoc(
+            parent=np.array([n], dtype=np.int32),
+            side=np.ones(n, dtype=np.int8),
+            key_agent=np.zeros(n, dtype=np.int32),
+            key_seq=np.zeros(n, dtype=np.int32),
+            vis_len=np.array([len(arr)], dtype=np.int32),
+            char_off=np.zeros(n, dtype=np.int32),
+            chars=arr if len(arr) else np.zeros(1, np.int32),
+            total_len=len(arr))
+    if common:
+        prefix, _ = ctx.merge_to_string("", [], common)
+    else:
+        prefix = ""
+    prefix_arr = np.frombuffer(prefix.encode("utf-32-le"), dtype=np.int32)
+    plen = len(prefix_arr)
+
+    s_ids, s_len, s_ol, s_orr, s_ev = split_runs_at_anchors(
+        ids, ln, ol, orr, (ev,))
+    agent, seq = _agent_keys(oplog, s_ids)
+    parent, side, ka, ks = build_tree_np(s_ids, s_len, s_ol, s_orr,
+                                         agent, seq)
+
+    uw = s_ids >= UNDERWATER
+    # Final visibility: a full checkout merges EVERY op, so an item is
+    # visible iff no delete op ever targeted it — the tracker's monotone
+    # `ever` flag. (The post-walk `state` reflects only the LAST walked
+    # piece's version: concurrent branches sit retreated, deletes from
+    # other branches sit undone — wrong for the merged frontier.)
+    # Underwater runs are structural anchors; only their overlap with the
+    # real prefix text [UNDERWATER, UNDERWATER+plen) is document text (the
+    # tracker seeds one giant placeholder span whose tail is not text).
+    uw_text = np.maximum(
+        0, np.minimum(s_ids + s_len, UNDERWATER + plen) - s_ids)
+    vis = np.where(s_ev != 0, 0, np.where(uw, uw_text, s_len))
+
+    from ..text.op import INS
+    arena_str = oplog.ops._arenas[INS].get((0, oplog.ops.arena_len(INS)))
+    arena = np.frombuffer(arena_str.encode("utf-32-le"), dtype=np.int32)
+    chars = np.concatenate([prefix_arr, arena]) if plen else arena
+    off = np.where(uw, s_ids - UNDERWATER,
+                   plen + _arena_offsets(oplog, np.where(uw, 0, s_ids)))
+
+    return DeviceDoc(
+        parent=parent.astype(np.int32), side=side.astype(np.int8),
+        key_agent=ka.astype(np.int32), key_seq=ks.astype(np.int32),
+        vis_len=vis.astype(np.int32), char_off=off.astype(np.int32),
+        chars=chars.astype(np.int32), total_len=int(vis.sum()))
+
+
+def _checkout_kernel(parent, side, key_agent, key_seq, vis_len, char_off,
+                     chars, cap: int):
+    perm = fugue_linearize_jax(parent, side, key_agent, key_seq)
+    return materialize_jax(perm, vis_len, char_off, chars, cap)
+
+
+_kernel_cache = {}
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(1, (int(x) - 1)).bit_length()
+
+
+def _jitted_kernel(cap: int):
+    """Compiled batched kernels keyed by the (power-of-two) capacity so
+    growing documents reuse O(log max_len) compiled executables instead of
+    recompiling per exact length."""
+    fn = _kernel_cache.get(cap)
+    if fn is None:
+        import jax
+        fn = jax.jit(jax.vmap(partial(_checkout_kernel, cap=cap)))
+        _kernel_cache[cap] = fn
+    return fn
+
+
+def checkout_device(oplog, doc: Optional[DeviceDoc] = None) -> str:
+    """Full checkout with device-side order resolution. Returns the text."""
+    if doc is None:
+        doc = prepare_doc(oplog)
+    return checkout_batch_device([doc])[0]
+
+
+def pad_docs(docs: List[DeviceDoc]):
+    """Stack documents into batch arrays. Shapes are padded to the next
+    power of two so repeated checkouts of growing documents hit the jit
+    trace cache instead of recompiling per exact size."""
+    n = _pow2(max(d.parent.shape[0] for d in docs))
+    pool = _pow2(max(d.chars.shape[0] for d in docs))
+    b = len(docs)
+    parent = np.full((b, n), 0, dtype=np.int32)
+    side = np.ones((b, n), dtype=np.int32)
+    ka = np.full((b, n), np.iinfo(np.int32).max, dtype=np.int32)
+    ks = np.full((b, n), np.iinfo(np.int32).max, dtype=np.int32)
+    vis = np.zeros((b, n), dtype=np.int32)
+    off = np.zeros((b, n), dtype=np.int32)
+    chars = np.zeros((b, pool), dtype=np.int32)
+    for i, d in enumerate(docs):
+        k = d.parent.shape[0]
+        # the kernel's virtual root is index n (padded size); remap each
+        # doc's own root (k) and hang padding rows off the root with huge
+        # keys so they linearize to the very end (zero visible text)
+        parent[i, :] = n
+        parent[i, :k] = np.where(d.parent == k, n, d.parent)
+        side[i, :k] = d.side
+        ka[i, :k] = d.key_agent
+        ks[i, :k] = d.key_seq
+        vis[i, :k] = d.vis_len
+        off[i, :k] = d.char_off
+        chars[i, :d.chars.shape[0]] = d.chars
+    return parent, side, ka, ks, vis, off, chars
+
+
+def checkout_batch_device(docs: List[DeviceDoc], cap: Optional[int] = None
+                          ) -> List[str]:
+    """Batched device checkout: one vmapped kernel call for all docs."""
+    import jax.numpy as jnp
+
+    parent, side, ka, ks, vis, off, chars = pad_docs(docs)
+    if cap is None:
+        cap = _pow2(max(max(d.total_len for d in docs), 1))
+    fn = _jitted_kernel(cap)
+    texts, totals = fn(*(jnp.asarray(x) for x in
+                         (parent, side, ka, ks, vis, off, chars)))
+    texts = np.asarray(texts)
+    totals = np.asarray(totals)
+    return [texts[i, :totals[i]].astype(np.int32).tobytes()
+            .decode("utf-32-le") for i in range(len(docs))]

@@ -1885,6 +1885,11 @@ struct Ctx {
   std::vector<i64> version;
   std::vector<XfOp> out;
   std::vector<i64> out_frontier;
+  // kept after transform for dt_dump_tracker (device-linearizer oracle)
+  std::unique_ptr<Tracker> last_tracker;
+  // conflict zone's common-ancestor frontier (the version whose document
+  // the tracker's underwater id space tiles)
+  std::vector<i64> zone_common;
 };
 
 static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
@@ -1931,9 +1936,10 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
 
 static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->out.clear();
+  c->last_tracker.reset();
   std::vector<Span> new_ops, conflict_ops;
   { PROF(conflict);
-    c->g.find_conflicting(
+    c->zone_common = c->g.find_conflicting(
         from, merge, [&](Span s, u8 flag) {
           push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
         });
@@ -1973,7 +1979,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   if (!new_ops.empty()) {
     if (did_ff) {
       conflict_ops.clear();
-      c->g.find_conflicting(
+      c->zone_common = c->g.find_conflicting(
           next_frontier, merge, [&](Span s, u8 flag) {
             if (flag != Graph::OnlyB) push_reversed_rle(conflict_ops, s);
           });
@@ -1987,7 +1993,8 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
     i64 zone_base = ops_top;
     for (const Span& s : conflict_ops) zone_base = std::min(zone_base, s.start);
     for (const Span& s : new_ops) zone_base = std::min(zone_base, s.start);
-    Tracker tracker(zone_base, ops_top);
+    c->last_tracker.reset(new Tracker(zone_base, ops_top));
+    Tracker& tracker = *c->last_tracker;
     std::unique_ptr<Zone> zp;
     { PROF(emit_misc); zp.reset(new Zone(c->g, conflict_ops, new_ops)); }
     Zone& zone = *zp;
@@ -2107,6 +2114,13 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
       c->doc.erase(x.pos, x.len);
     }
   }
+  // plain merges don't need the tracker afterwards — release its O(zone)
+  // tables instead of pinning them on the context (dt_transform callers
+  // that want dt_dump_tracker keep theirs); zone_common is cleared with it
+  // so the dump/zone_common pair can never disagree about which transform
+  // they describe
+  c->last_tracker.reset();
+  c->zone_common.clear();
   return c->doc.total;
 }
 
@@ -2121,6 +2135,38 @@ void dt_get_out(void* p, i64* lv, i64* len, u8* kind, u8* fwd, i64* pos) {
     fwd[i] = c->out[i].fwd;
     pos[i] = c->out[i].pos;
   }
+}
+
+// Tracker item-table export (validation ground truth for the device
+// linearizer, diamond_types_tpu/tpu/linearize.py): after dt_transform the
+// last tracker is dumped in DOCUMENT ORDER as per-entry rows
+// (ids, len, origin_left, origin_right, state, ever). Returns row count
+// (call with null buffers to size). Rows include the underwater sentinel
+// span(s); callers filter ids >= 1<<62.
+i64 dt_dump_tracker(void* p, i64 cap, i64* ids, i64* len, i64* ol,
+                    i64* orr, i64* state, u8* ever) {
+  Ctx* c = (Ctx*)p;
+  if (!c->last_tracker) return 0;
+  i64 k = 0;
+  for (BLeaf* lf = c->last_tracker->first_leaf; lf; lf = lf->next)
+    for (int i = 0; i < lf->n; i++, k++)
+      if (k < cap) {
+        ids[k] = lf->e[i].ids;
+        len[k] = lf->e[i].len;
+        ol[k] = lf->e[i].ol;
+        orr[k] = lf->e[i].orr;
+        state[k] = lf->e[i].state;
+        ever[k] = lf->e[i].ever ? 1 : 0;
+      }
+  return k;
+}
+
+// Common-ancestor frontier of the last transform's conflict zone.
+i64 dt_get_zone_common(void* p, i64* buf, i64 cap) {
+  Ctx* c = (Ctx*)p;
+  i64 n = std::min((i64)c->zone_common.size(), cap);
+  for (i64 i = 0; i < n; i++) buf[i] = c->zone_common[i];
+  return (i64)c->zone_common.size();
 }
 
 i64 dt_get_out_frontier(void* p, i64* buf, i64 cap) {

@@ -1,0 +1,144 @@
+"""Device linearizer (listmerge_tpu) — exactness against the native tracker.
+
+The Fugue-tree linearization (diamond_types_tpu/tpu/linearize.py) must
+reproduce the sequential YjsMod integrate order (reference:
+src/listmerge/merge.rs:154-278) ITEM-FOR-ITEM, and the device checkout
+(tpu/merge_kernel.py) must produce byte-identical documents.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from diamond_types_tpu.encoding.decode import decode_into, load_oplog
+from diamond_types_tpu.encoding.encode import encode_oplog
+from diamond_types_tpu.text.crdt import ListCRDT
+from diamond_types_tpu.native.core import NativeContext, native_available
+from diamond_types_tpu.tpu.linearize import (UNDERWATER, build_tree_np,
+                                             fugue_linearize_jax,
+                                             fugue_order_np,
+                                             split_runs_at_anchors)
+from diamond_types_tpu.tpu.merge_kernel import (_agent_keys, checkout_device,
+                                                checkout_batch_device,
+                                                prepare_doc)
+
+from conftest import reference_path
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native core unavailable")
+
+
+def _tracker_table(oplog):
+    ctx = NativeContext(oplog)
+    ctx.transform([], [int(x) for x in oplog.version])
+    return ctx.dump_tracker(keep_underwater=True)
+
+
+def _expand(ids, length):
+    length = np.where(ids >= UNDERWATER, 1, length)
+    return np.concatenate([np.arange(i, i + l)
+                           for i, l in zip(ids, length)])
+
+
+def _fuzz_oplog(seed, steps=20):
+    rng = random.Random(seed)
+    base = ListCRDT()
+    a = base.get_or_create_agent_id("root")
+    base.insert(a, 0, "".join(rng.choice("abcd") for _ in range(60)))
+    data = encode_oplog(base.oplog)
+    peers = []
+    for nm in ["p0", "p1", "p2"]:
+        c = ListCRDT()
+        decode_into(c.oplog, data)
+        c.branch = c.oplog.checkout_tip()
+        peers.append((c, c.get_or_create_agent_id(nm)))
+    for _ in range(steps):
+        c, agn = peers[rng.randrange(3)]
+        doc_len = len(c.branch.snapshot())
+        if doc_len > 20 and rng.random() < 0.4:
+            p = rng.randrange(0, doc_len - 8)
+            c.delete(agn, p, p + rng.randrange(1, 8))
+        else:
+            p = rng.randrange(0, doc_len + 1)
+            c.insert(agn, p, "".join(rng.choice("WXYZ")
+                                     for _ in range(rng.randrange(1, 6))))
+    c0 = peers[0][0]
+    for d in [encode_oplog(c.oplog) for c, _ in peers]:
+        decode_into(c0.oplog, d)
+    return c0.oplog
+
+
+def _order_matches_tracker(oplog):
+    ids, ln, ol, orr, st, ev = _tracker_table(oplog)
+    if len(ids) == 0:
+        return True
+    s_ids, s_len, s_ol, s_orr = split_runs_at_anchors(ids, ln, ol, orr)
+    ag, sq = _agent_keys(oplog, s_ids)
+    perm = fugue_order_np(s_ids, s_len, s_ol, s_orr, ag, sq)
+    truth = _expand(ids, ln)
+    mine = _expand(s_ids[perm], s_len[perm])
+    return len(truth) == len(mine) and bool((truth == mine).all())
+
+
+@pytest.mark.parametrize("corpus", ["friendsforever.dt", "git-makefile.dt",
+                                    "node_nodecc.dt"])
+def test_order_matches_tracker_corpora(corpus):
+    ol = load_oplog(open(reference_path("benchmark_data", corpus),
+                         "rb").read())
+    assert _order_matches_tracker(ol)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_order_matches_tracker_fuzz(seed):
+    assert _order_matches_tracker(_fuzz_oplog(seed))
+
+
+def test_jax_matches_numpy_reference():
+    ol = load_oplog(open(reference_path("benchmark_data",
+                                        "friendsforever.dt"), "rb").read())
+    ids, ln, olg, orr, st, ev = _tracker_table(ol)
+    s_ids, s_len, s_ol, s_orr = split_runs_at_anchors(ids, ln, olg, orr)
+    ag, sq = _agent_keys(ol, s_ids)
+    perm_np = fugue_order_np(s_ids, s_len, s_ol, s_orr, ag, sq)
+    parent, side, ka, ks = build_tree_np(s_ids, s_len, s_ol, s_orr, ag, sq)
+    import jax
+    import jax.numpy as jnp
+    perm_jax = np.asarray(jax.jit(fugue_linearize_jax)(
+        jnp.asarray(parent), jnp.asarray(side),
+        jnp.asarray(ka), jnp.asarray(ks)))
+    assert (perm_np == perm_jax).all()
+
+
+@pytest.mark.parametrize("corpus", ["friendsforever.dt", "git-makefile.dt",
+                                    "node_nodecc.dt"])
+def test_device_checkout_corpora(corpus):
+    ol = load_oplog(open(reference_path("benchmark_data", corpus),
+                         "rb").read())
+    assert checkout_device(ol) == ol.checkout_tip().snapshot()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_checkout_fuzz(seed):
+    ol = _fuzz_oplog(seed)
+    assert checkout_device(ol) == ol.checkout_tip().snapshot()
+
+
+def test_device_checkout_batched():
+    oplogs = [_fuzz_oplog(s) for s in range(5)]
+    texts = checkout_batch_device([prepare_doc(o) for o in oplogs])
+    for t, o in zip(texts, oplogs):
+        assert t == o.checkout_tip().snapshot()
+
+
+def test_device_checkout_linear_doc():
+    lin = ListCRDT()
+    a = lin.get_or_create_agent_id("solo")
+    lin.insert(a, 0, "hello world")
+    lin.delete(a, 2, 5)
+    assert checkout_device(lin.oplog) == lin.oplog.checkout_tip().snapshot()
+
+
+def test_device_checkout_empty_doc():
+    empty = ListCRDT()
+    assert checkout_device(empty.oplog) == ""
