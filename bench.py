@@ -88,6 +88,59 @@ def bench_tpu_batch(batch: int = 1024, n_ops: int = 256, cap: int = 1024,
     return None
 
 
+_MERGE_KERNEL_SNIPPET = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from diamond_types_tpu.encoding.decode import load_oplog
+from diamond_types_tpu.tpu.merge_kernel import prepare_doc, pad_docs, _jitted_kernel, _pow2
+ol = load_oplog(open({data!r}, 'rb').read())
+doc = prepare_doc(ol)   # host origin extraction (once; device is the bench)
+docs = [doc] * {batch}
+import jax, jax.numpy as jnp
+parent, side, ka, ks, vis, off, chars = pad_docs(docs)
+cap = _pow2(doc.total_len)
+fn = _jitted_kernel(cap)
+args = tuple(jnp.asarray(x) for x in (parent, side, ka, ks, vis, off, chars))
+texts, totals = fn(*args)
+texts.block_until_ready()
+t0 = time.perf_counter()
+texts, totals = fn(*args)
+texts.block_until_ready()
+dt = time.perf_counter() - t0
+expected = ol.checkout_tip().snapshot()
+got = np.asarray(texts[0][:int(totals[0])]).astype(np.int32).tobytes().decode('utf-32-le')
+assert got == expected, 'device merge diverged from host engine'
+print("RESULT", {batch} * len(ol) / dt)
+"""
+
+
+def bench_device_merge(batch: int = 256, timeout: int = 240):
+    """Batched device MERGE-kernel checkout (Fugue-tree linearization of
+    friendsforever's 2-agent concurrent history, x batch replicas): the
+    device resolves concurrent order + assembles text; parity-checked
+    against the host engine inside the subprocess."""
+    import subprocess
+    code = _MERGE_KERNEL_SNIPPET.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        data=os.path.join(BENCH_DATA, "friendsforever.dt"),
+        batch=batch)
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT "):
+                return float(line.split()[1])
+        if r.returncode != 0:
+            # a real failure (e.g. the in-subprocess parity assert), NOT
+            # missing hardware — surface it instead of swallowing it
+            return ("error", r.stderr.strip().splitlines()[-1][:200]
+                    if r.stderr.strip() else f"exit {r.returncode}")
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return None
+
+
 def bench_linear_replay():
     """BASELINE config 1: automerge-paper linear single-branch replay."""
     from diamond_types_tpu.text.trace import load_trace, replay_into_oplog
@@ -137,6 +190,12 @@ def main() -> None:
     tpu = bench_tpu_batch()
     if tpu is not None:
         extra["tpu_batched_replay_ops_per_sec"] = round(tpu)
+
+    dm = bench_device_merge()
+    if isinstance(dm, tuple):
+        extra["tpu_batched_merge_error"] = dm[1]
+    elif dm is not None:
+        extra["tpu_batched_merge_ops_per_sec"] = round(dm)
 
     print(json.dumps({
         "metric": "git-makefile.dt merge throughput",

@@ -77,6 +77,7 @@ def _load():
     lib.dt_get_zone_common.argtypes = [
         ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
     lib.dt_get_zone_common.restype = ct.c_int64
+    lib.dt_release_tracker.argtypes = [ct.c_void_p]
     _lib = lib
     return lib
 
@@ -176,6 +177,10 @@ class NativeContext:
         frontier = [int(x) for x in fbuf[:k]]
         return lv, ln, kind, fwd, pos, frontier
 
+
+    def release_tracker(self) -> None:
+        """Free the tracker tables retained for dump_tracker/zone_common."""
+        self._lib.dt_release_tracker(self._ptr)
 
     def zone_common(self):
         """Common-ancestor frontier of the last transform's conflict zone

@@ -98,6 +98,7 @@ def prepare_doc(oplog) -> DeviceDoc:
         # no conflict zone at all (purely linear history): the document is
         # the fast-forward result; model it as one visible pseudo-run
         prefix, _ = ctx.merge_to_string("", [], merge)
+        ctx.release_tracker()
         arr = np.frombuffer(prefix.encode("utf-32-le"), dtype=np.int32)
         n = 1
         return DeviceDoc(
@@ -113,6 +114,7 @@ def prepare_doc(oplog) -> DeviceDoc:
         prefix, _ = ctx.merge_to_string("", [], common)
     else:
         prefix = ""
+    ctx.release_tracker()  # the dump above is all we needed
     prefix_arr = np.frombuffer(prefix.encode("utf-32-le"), dtype=np.int32)
     plen = len(prefix_arr)
 

@@ -2161,6 +2161,14 @@ i64 dt_dump_tracker(void* p, i64 cap, i64* ids, i64* len, i64* ol,
   return k;
 }
 
+// Release the retained tracker + zone frontier (callers that are done
+// with dt_dump_tracker / dt_get_zone_common free the O(zone) tables).
+void dt_release_tracker(void* p) {
+  Ctx* c = (Ctx*)p;
+  c->last_tracker.reset();
+  c->zone_common.clear();
+}
+
 // Common-ancestor frontier of the last transform's conflict zone.
 i64 dt_get_zone_common(void* p, i64* buf, i64 cap) {
   Ctx* c = (Ctx*)p;
