@@ -113,77 +113,14 @@ def split_runs_at_anchors(ids: np.ndarray, length: np.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
-                   orr: np.ndarray, agent: np.ndarray, seq: np.ndarray
-                   ) -> np.ndarray:
-    """Return the permutation of run indices giving document order.
+def _doc_order_np(parent: np.ndarray, side: np.ndarray, key_pos: np.ndarray,
+                  key_agent: np.ndarray, key_seq: np.ndarray) -> np.ndarray:
+    """DFS of the Fugue tree (parent == n is the virtual root) under the
+    sibling sort (key_pos, key_agent, key_seq). Host-side mirror of
+    fugue_linearize_jax."""
+    n = len(parent)
+    order = np.lexsort((key_seq, key_agent, key_pos, side, parent))
 
-    Precondition: runs are anchor-split (split_runs_at_anchors) — every ol
-    is some run's last item, every orr some run's first item.
-
-    Tree rules (empirically validated == YjsMod; see module docstring):
-      * parent/side: run x is a LEFT child of the run starting at orr(x)
-        when that run shares x's left origin (same insertion gap — the
-        "b.leftOrigin == a" Fugue condition); otherwise x is a RIGHT child
-        of the run whose last item is ol(x) (ol == ROOT → right child of
-        the virtual root).
-      * RIGHT children of the same parent sort by the YjsMod sibling order:
-        right-origin position DESCENDING, then (agent rank, seq) ascending.
-        LEFT children likewise.
-    The right-origin "position" ordering is resolved structurally: after
-    anchor splitting, two same-gap siblings with different right origins
-    are routed to different parents (the one anchored on the nearer orr
-    becomes that run's left child), so same-(parent, side) siblings with
-    different orr can only be compared through tree depth — the sort key
-    falls back to (agent, seq) exactly when orr ties.
-    """
-    n = len(ids)
-    ends = ids + length
-    # run lookup tables
-    start_of = {int(v): i for i, v in enumerate(ids)}
-    end_of = {int(e) - 1: i for i, e in enumerate(ends)}
-
-    def run_starting(lv):
-        return start_of.get(int(lv), -2)
-
-    def run_ending(lv):
-        return end_of.get(int(lv), -2)
-
-    parent = np.full(n, -1, dtype=np.int64)   # -1 = virtual root
-    side = np.zeros(n, dtype=np.int8)         # 0 = left child, 1 = right
-
-    for i in range(n):
-        if ids[i] >= UNDERWATER:
-            # pre-zone text: fixed spine, right children of the root in id
-            # order (underwater ids ascend with document position)
-            parent[i] = -1
-            side[i] = 1
-            continue
-        r = run_starting(orr[i]) if orr[i] != ROOT else -2
-        if r >= 0 and ol[r] == ol[i]:
-            parent[i] = r
-            side[i] = 0
-        else:
-            if ol[i] == ROOT:
-                parent[i] = -1
-                side[i] = 1
-            else:
-                p = run_ending(ol[i])
-                assert p >= 0, f"unsplit ol anchor {ol[i]}"
-                parent[i] = p
-                side[i] = 1
-
-    # sibling sort keys
-    # underwater runs order by id among root's right children, ahead of
-    # nothing special — real items at the root compare by (agent, seq)
-    uw = ids >= UNDERWATER
-    key_agent = np.where(uw, -1, agent)
-    uw_sorted = np.sort(ids[uw])
-    key_seq = np.where(uw, np.searchsorted(uw_sorted, ids), seq)
-
-    order = np.lexsort((key_seq, key_agent, side, parent))
-
-    # children lists
     from collections import defaultdict
     kids_left = defaultdict(list)
     kids_right = defaultdict(list)
@@ -193,7 +130,7 @@ def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
     out = np.empty(n, dtype=np.int64)
     w = 0
     # iterative DFS: (node, phase) — phase 0 = emit left kids, 1 = self+right
-    stack = [(-1, 0)]
+    stack = [(n, 0)]
     while stack:
         node, phase = stack.pop()
         if phase == 0:
@@ -201,13 +138,92 @@ def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
             for c in reversed(kids_left.get(node, ())):
                 stack.append((c, 0))
         else:
-            if node >= 0:
+            if node < n:
                 out[w] = node
                 w += 1
             for c in reversed(kids_right.get(node, ())):
                 stack.append((c, 0))
     assert w == n
     return out
+
+
+def resolve_pos_keys(parent: np.ndarray, side: np.ndarray,
+                     key_agent: np.ndarray, key_seq: np.ndarray,
+                     orr_run: np.ndarray, max_rounds: int = 64) -> np.ndarray:
+    """Right-origin position sort key per run (the YjsMod `scanning` rule,
+    reference merge.rs:230-242: same-left-origin concurrent siblings order
+    by right-origin DOCUMENT POSITION, descending, before the agent
+    tie-break).
+
+    Returned key is ascending-sorts-first: `n - rank(orr)` so a farther
+    right origin gives a smaller key; ROOT (document end — the farthest
+    possible right origin) and underwater runs get 0.
+
+    The key depends on the document order, which depends on the key — but
+    the recursion is well-founded: the order of a sibling pair (u, v)
+    depends only on the order of their right-origin targets, both of which
+    have strictly smaller LVs (origins causally precede their items), so
+    iterating order → keys → order converges stratum by stratum. Almost
+    every document converges in 0 rounds (no same-(parent, side) sibling
+    group has heterogeneous right origins) or 2 (compute + verify)."""
+    n = len(parent)
+    key_pos = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return key_pos
+    # fast path: if every (parent, side) sibling group shares one orr_run,
+    # the key ties inside every group and cannot affect the order
+    grp = parent.astype(np.int64) * 2 + side
+    o = np.lexsort((orr_run, grp))
+    gs, rs = grp[o], orr_run[o]
+    if not ((gs[1:] == gs[:-1]) & (rs[1:] != rs[:-1])).any():
+        return key_pos
+    for _ in range(max_rounds):
+        out = _doc_order_np(parent, side, key_pos, key_agent, key_seq)
+        rank = np.empty(n, dtype=np.int64)
+        rank[out] = np.arange(n)
+        new = np.where(orr_run >= 0, n - rank[np.clip(orr_run, 0, n - 1)], 0)
+        if (new == key_pos).all():
+            return key_pos
+        key_pos = new
+    raise AssertionError("right-origin position keys did not converge")
+
+
+def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
+                   orr: np.ndarray, agent: np.ndarray, seq: np.ndarray
+                   ) -> np.ndarray:
+    """Return the permutation of run indices giving document order.
+
+    Precondition: runs are anchor-split (split_runs_at_anchors) — every ol
+    is some run's last item, every orr some run's first item.
+
+    Tree rules (== YjsMod; validated vs the native tracker on corpora +
+    cross-sync fuzz):
+      * parent/side: run x is a LEFT child of the run starting at orr(x)
+        when that run shares x's left origin (same insertion gap — the
+        "b.leftOrigin == a" Fugue condition); otherwise x is a RIGHT child
+        of the run whose last item is ol(x) (ol == ROOT → right child of
+        the virtual root).
+      * Same-(parent, side) siblings sort by the YjsMod order: right-origin
+        document position DESCENDING (reference merge.rs:230-242, the
+        `scanning` branch), then (agent rank, seq) ascending. The position
+        rank is well-defined before the full order is known because the
+        relative order of two existing items never changes as later items
+        are inserted between them; `resolve_pos_keys` computes it by a
+        (rarely needed) fixed point.
+    Soundness of the flat sibling ordering: a sibling's right origin can
+    never point strictly inside another sibling's subtree. origin_right is
+    the immediate tracker successor skipping only NOT_INSERTED_YET items
+    (reference merge.rs:407-424) — any item between the insertion gap and
+    a deeper target would have to be NIY (concurrent), yet it causally
+    precedes the target (origins precede items), which causally precedes
+    the new item: contradiction. The only reachable interior targets are
+    the left spine of the next subtree, whose members share the new item's
+    origin-left, so the LEFT-child rule routes those exactly.
+    """
+    parent, side, key_agent, key_seq, orr_run = build_tree_np(
+        ids, length, ol, orr, agent, seq)
+    key_pos = resolve_pos_keys(parent, side, key_agent, key_seq, orr_run)
+    return _doc_order_np(parent, side, key_pos, key_agent, key_seq)
 
 
 # ---------------------------------------------------------------------------
@@ -217,11 +233,15 @@ def fugue_order_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
 
 def build_tree_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
                   orr: np.ndarray, agent: np.ndarray, seq: np.ndarray
-                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                             np.ndarray]:
     """Vectorized parent/side/key computation for anchor-split runs.
 
-    Returns (parent, side, key_agent, key_seq); parent == n means the
-    virtual root (index n)."""
+    Returns (parent, side, key_agent, key_seq, orr_run); parent == n means
+    the virtual root (index n). orr_run maps each run's origin-right LV to
+    the index of the run starting at that LV, or -1 for ROOT (document
+    end) and for underwater runs (the fixed pre-zone spine takes no part
+    in right-origin ordering) — the input resolve_pos_keys needs."""
     n = len(ids)
     ends = ids + length
     order_s = np.argsort(ids, kind="stable")
@@ -243,6 +263,8 @@ def build_tree_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
 
     uw = ids >= UNDERWATER
     r = np.where(orr != ROOT, run_starting(orr), -2)
+    assert ((r >= 0) | (orr == ROOT)).all(), "unsplit orr anchor"
+    orr_run = np.where(uw | (r < 0), -1, r).astype(np.int64)
     r_ok = (r >= 0) & (ol[np.clip(r, 0, n - 1)] == ol) & ~uw
     p_right = np.where(ol == ROOT, n, run_ending(ol))
     parent = np.where(uw, n, np.where(r_ok, r, p_right)).astype(np.int64)
@@ -254,11 +276,13 @@ def build_tree_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
     uw_sorted = np.sort(ids[uw])
     uw_rank = np.searchsorted(uw_sorted, ids)
     key_seq = np.where(uw, uw_rank, seq).astype(np.int64)
-    # the device kernel runs in int32: keys must fit (seq/agent counts do
-    # for any real oplog; fail loudly rather than silently mis-sorting)
-    assert key_seq.max(initial=0) < 2**31 and key_agent.max(initial=0) < 2**31
+    # the device kernel runs in int32 and pad_docs marks padding rows with
+    # INT32_MAX: real keys must stay strictly below it (fail loudly rather
+    # than silently mis-sorting)
+    assert (key_seq.max(initial=0) < 2**31 - 1
+            and key_agent.max(initial=0) < 2**31 - 1)
     assert (parent >= 0).all(), "unsplit anchor"
-    return parent, side, key_agent, key_seq
+    return parent, side, key_agent, key_seq, orr_run
 
 
 # ---------------------------------------------------------------------------
@@ -266,13 +290,15 @@ def build_tree_np(ids: np.ndarray, length: np.ndarray, ol: np.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def fugue_linearize_jax(parent, side, key_agent, key_seq):
+def fugue_linearize_jax(parent, side, key_pos, key_agent, key_seq):
     """Document-order permutation of n tree nodes on device.
 
     All inputs are int arrays of length n (parent == n denotes the virtual
-    root). Returns perm [n]: node indices in document order. Padding nodes
-    should carry parent == n, side == 1, key_agent == INT_MAX-ish so they
-    sort to the end of the document.
+    root). key_pos is the right-origin position key from resolve_pos_keys
+    (YjsMod orders same-gap siblings by right-origin position before the
+    agent tie-break). Returns perm [n]: node indices in document order.
+    Padding nodes should carry parent == n, side == 1, and INT_MAX-ish
+    key_pos/key_agent so they sort to the end of the document.
 
     Pure sorts/gathers/scans — no data-dependent control flow. The DFS is
     computed via a threaded Euler tour (3 cells per node: pre, visit,
@@ -284,9 +310,9 @@ def fugue_linearize_jax(parent, side, key_agent, key_seq):
     n = parent.shape[0]
     root = n
 
-    # sibling order: (parent, side, key_agent, key_seq)
-    sort_idx = jnp.lexsort((key_seq, key_agent, side.astype(jnp.int32),
-                            parent))
+    # sibling order: (parent, side, key_pos, key_agent, key_seq)
+    sort_idx = jnp.lexsort((key_seq, key_agent, key_pos,
+                            side.astype(jnp.int32), parent))
     p_s = parent[sort_idx]
     s_s = side[sort_idx].astype(jnp.int32)
     grp = p_s * 2 + s_s

@@ -33,7 +33,7 @@ import numpy as np
 
 from .linearize import (ROOT, UNDERWATER, build_tree_np,
                         fugue_linearize_jax, materialize_jax,
-                        split_runs_at_anchors)
+                        resolve_pos_keys, split_runs_at_anchors)
 
 
 @dataclass
@@ -41,6 +41,7 @@ class DeviceDoc:
     """Host-prepared dense tables for one document's device checkout."""
     parent: np.ndarray      # [n] int32, parent == n -> virtual root
     side: np.ndarray        # [n] int8, 0 left / 1 right child
+    key_pos: np.ndarray     # [n] int32 sibling sort key (orr position desc)
     key_agent: np.ndarray   # [n] int32 sibling sort key (agent name rank)
     key_seq: np.ndarray     # [n] int32 sibling sort key (seq)
     vis_len: np.ndarray     # [n] int32 visible chars contributed by run
@@ -104,6 +105,7 @@ def prepare_doc(oplog) -> DeviceDoc:
         return DeviceDoc(
             parent=np.array([n], dtype=np.int32),
             side=np.ones(n, dtype=np.int8),
+            key_pos=np.zeros(n, dtype=np.int32),
             key_agent=np.zeros(n, dtype=np.int32),
             key_seq=np.zeros(n, dtype=np.int32),
             vis_len=np.array([len(arr)], dtype=np.int32),
@@ -121,8 +123,9 @@ def prepare_doc(oplog) -> DeviceDoc:
     s_ids, s_len, s_ol, s_orr, s_ev = split_runs_at_anchors(
         ids, ln, ol, orr, (ev,))
     agent, seq = _agent_keys(oplog, s_ids)
-    parent, side, ka, ks = build_tree_np(s_ids, s_len, s_ol, s_orr,
-                                         agent, seq)
+    parent, side, ka, ks, orr_run = build_tree_np(s_ids, s_len, s_ol, s_orr,
+                                                  agent, seq)
+    kp = resolve_pos_keys(parent, side, ka, ks, orr_run)
 
     uw = s_ids >= UNDERWATER
     # Final visibility: a full checkout merges EVERY op, so an item is
@@ -146,14 +149,15 @@ def prepare_doc(oplog) -> DeviceDoc:
 
     return DeviceDoc(
         parent=parent.astype(np.int32), side=side.astype(np.int8),
+        key_pos=kp.astype(np.int32),
         key_agent=ka.astype(np.int32), key_seq=ks.astype(np.int32),
         vis_len=vis.astype(np.int32), char_off=off.astype(np.int32),
         chars=chars.astype(np.int32), total_len=int(vis.sum()))
 
 
-def _checkout_kernel(parent, side, key_agent, key_seq, vis_len, char_off,
-                     chars, cap: int):
-    perm = fugue_linearize_jax(parent, side, key_agent, key_seq)
+def _checkout_kernel(parent, side, key_pos, key_agent, key_seq, vis_len,
+                     char_off, chars, cap: int):
+    perm = fugue_linearize_jax(parent, side, key_pos, key_agent, key_seq)
     return materialize_jax(perm, vis_len, char_off, chars, cap)
 
 
@@ -192,6 +196,7 @@ def pad_docs(docs: List[DeviceDoc]):
     b = len(docs)
     parent = np.full((b, n), 0, dtype=np.int32)
     side = np.ones((b, n), dtype=np.int32)
+    kp = np.full((b, n), np.iinfo(np.int32).max, dtype=np.int32)
     ka = np.full((b, n), np.iinfo(np.int32).max, dtype=np.int32)
     ks = np.full((b, n), np.iinfo(np.int32).max, dtype=np.int32)
     vis = np.zeros((b, n), dtype=np.int32)
@@ -205,12 +210,13 @@ def pad_docs(docs: List[DeviceDoc]):
         parent[i, :] = n
         parent[i, :k] = np.where(d.parent == k, n, d.parent)
         side[i, :k] = d.side
+        kp[i, :k] = d.key_pos
         ka[i, :k] = d.key_agent
         ks[i, :k] = d.key_seq
         vis[i, :k] = d.vis_len
         off[i, :k] = d.char_off
         chars[i, :d.chars.shape[0]] = d.chars
-    return parent, side, ka, ks, vis, off, chars
+    return parent, side, kp, ka, ks, vis, off, chars
 
 
 def checkout_batch_device(docs: List[DeviceDoc], cap: Optional[int] = None
@@ -218,12 +224,12 @@ def checkout_batch_device(docs: List[DeviceDoc], cap: Optional[int] = None
     """Batched device checkout: one vmapped kernel call for all docs."""
     import jax.numpy as jnp
 
-    parent, side, ka, ks, vis, off, chars = pad_docs(docs)
+    parent, side, kp, ka, ks, vis, off, chars = pad_docs(docs)
     if cap is None:
         cap = _pow2(max(max(d.total_len for d in docs), 1))
     fn = _jitted_kernel(cap)
     texts, totals = fn(*(jnp.asarray(x) for x in
-                         (parent, side, ka, ks, vis, off, chars)))
+                         (parent, side, kp, ka, ks, vis, off, chars)))
     texts = np.asarray(texts)
     totals = np.asarray(totals)
     return [texts[i, :totals[i]].astype(np.int32).tobytes()

@@ -18,6 +18,7 @@ from diamond_types_tpu.native.core import NativeContext, native_available
 from diamond_types_tpu.tpu.linearize import (UNDERWATER, build_tree_np,
                                              fugue_linearize_jax,
                                              fugue_order_np,
+                                             resolve_pos_keys,
                                              split_runs_at_anchors)
 from diamond_types_tpu.tpu.merge_kernel import (_agent_keys, checkout_device,
                                                 checkout_batch_device,
@@ -41,20 +42,31 @@ def _expand(ids, length):
                            for i, l in zip(ids, length)])
 
 
-def _fuzz_oplog(seed, steps=20):
+def _fuzz_oplog(seed, steps=20, cross_sync=False):
+    """Random concurrent history over 3+ peers.
+
+    With cross_sync=True, peers exchange encoded oplogs MID-RUN and new
+    peers spawn from stale snapshots — so items' origins can themselves be
+    tie-broken concurrent inserts (the class that triggered the round-1
+    sibling-order divergence; ADVICE.md finding #2)."""
     rng = random.Random(seed)
     base = ListCRDT()
     a = base.get_or_create_agent_id("root")
     base.insert(a, 0, "".join(rng.choice("abcd") for _ in range(60)))
     data = encode_oplog(base.oplog)
     peers = []
-    for nm in ["p0", "p1", "p2"]:
+
+    def spawn(nm, data):
         c = ListCRDT()
         decode_into(c.oplog, data)
         c.branch = c.oplog.checkout_tip()
         peers.append((c, c.get_or_create_agent_id(nm)))
+
+    for nm in ["p0", "p1", "p2"]:
+        spawn(nm, data)
     for _ in range(steps):
-        c, agn = peers[rng.randrange(3)]
+        i = rng.randrange(len(peers))
+        c, agn = peers[i]
         doc_len = len(c.branch.snapshot())
         if doc_len > 20 and rng.random() < 0.4:
             p = rng.randrange(0, doc_len - 8)
@@ -63,10 +75,57 @@ def _fuzz_oplog(seed, steps=20):
             p = rng.randrange(0, doc_len + 1)
             c.insert(agn, p, "".join(rng.choice("WXYZ")
                                      for _ in range(rng.randrange(1, 6))))
+        if cross_sync and rng.random() < 0.35:
+            j = rng.randrange(len(peers))
+            if j != i:
+                cj = peers[j][0]
+                decode_into(cj.oplog, encode_oplog(c.oplog))
+                cj.branch = cj.oplog.checkout_tip()
+        if cross_sync and len(peers) < 6 and rng.random() < 0.15:
+            # a peer joining from a stale snapshot of another peer
+            src = peers[rng.randrange(len(peers))][0]
+            spawn(f"q{len(peers)}", encode_oplog(src.oplog))
     c0 = peers[0][0]
     for d in [encode_oplog(c.oplog) for c, _ in peers]:
         decode_into(c0.oplog, d)
     return c0.oplog
+
+
+def _advisor_repro_oplog():
+    """ADVICE.md round-1 high-severity repro: same-(parent, side) siblings
+    with different right origins. base 'WY'; a/b concurrently insert P/X
+    between W and Y (tie-break puts P first); d (sees all) inserts '1'
+    between P and X (ol=P, orr=X); e (sees only P's branch) inserts '2'
+    between P and Y (ol=P, orr=Y). YjsMod orders '2' before '1' (right
+    origin Y is FARTHER right than X): 'WP21XY'."""
+    base = ListCRDT()
+    r = base.get_or_create_agent_id("root")
+    base.insert(r, 0, "WY")
+    d0 = encode_oplog(base.oplog)
+
+    def peer(name, *patches):
+        c = ListCRDT()
+        for p in (d0,) + patches:
+            decode_into(c.oplog, p)
+        c.branch = c.oplog.checkout_tip()
+        return c, c.get_or_create_agent_id(name)
+
+    pa, a = peer("a")
+    pa.insert(a, 1, "P")
+    da = encode_oplog(pa.oplog)
+    pb, b = peer("b")
+    pb.insert(b, 1, "X")
+    db = encode_oplog(pb.oplog)
+    pd, d = peer("d", da, db)
+    assert pd.branch.snapshot() == "WPXY"
+    pd.insert(d, 2, "1")
+    pe, e = peer("e", da)
+    assert pe.branch.snapshot() == "WPY"
+    pe.insert(e, 2, "2")
+    final = ListCRDT()
+    for p in (d0, da, db, encode_oplog(pd.oplog), encode_oplog(pe.oplog)):
+        decode_into(final.oplog, p)
+    return final.oplog
 
 
 def _order_matches_tracker(oplog):
@@ -94,6 +153,22 @@ def test_order_matches_tracker_fuzz(seed):
     assert _order_matches_tracker(_fuzz_oplog(seed))
 
 
+@pytest.mark.parametrize("seed", range(30))
+def test_order_matches_tracker_cross_sync_fuzz(seed):
+    assert _order_matches_tracker(
+        _fuzz_oplog(seed, steps=30, cross_sync=True))
+
+
+def test_sibling_order_right_origin_rule():
+    """Round-1 ADVICE high-severity regression: YjsMod orders same-gap
+    siblings by right-origin position DESCENDING before the agent key."""
+    ol = _advisor_repro_oplog()
+    host = ol.checkout_tip().snapshot()
+    assert host == "WP21XY"
+    assert _order_matches_tracker(ol)
+    assert checkout_device(ol) == host
+
+
 def test_jax_matches_numpy_reference():
     ol = load_oplog(open(reference_path("benchmark_data",
                                         "friendsforever.dt"), "rb").read())
@@ -101,11 +176,13 @@ def test_jax_matches_numpy_reference():
     s_ids, s_len, s_ol, s_orr = split_runs_at_anchors(ids, ln, olg, orr)
     ag, sq = _agent_keys(ol, s_ids)
     perm_np = fugue_order_np(s_ids, s_len, s_ol, s_orr, ag, sq)
-    parent, side, ka, ks = build_tree_np(s_ids, s_len, s_ol, s_orr, ag, sq)
+    parent, side, ka, ks, orr_run = build_tree_np(s_ids, s_len, s_ol, s_orr,
+                                                  ag, sq)
+    kp = resolve_pos_keys(parent, side, ka, ks, orr_run)
     import jax
     import jax.numpy as jnp
     perm_jax = np.asarray(jax.jit(fugue_linearize_jax)(
-        jnp.asarray(parent), jnp.asarray(side),
+        jnp.asarray(parent), jnp.asarray(side), jnp.asarray(kp),
         jnp.asarray(ka), jnp.asarray(ks)))
     assert (perm_np == perm_jax).all()
 
@@ -121,6 +198,12 @@ def test_device_checkout_corpora(corpus):
 @pytest.mark.parametrize("seed", range(6))
 def test_device_checkout_fuzz(seed):
     ol = _fuzz_oplog(seed)
+    assert checkout_device(ol) == ol.checkout_tip().snapshot()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_checkout_cross_sync_fuzz(seed):
+    ol = _fuzz_oplog(seed + 100, steps=30, cross_sync=True)
     assert checkout_device(ol) == ol.checkout_tip().snapshot()
 
 
