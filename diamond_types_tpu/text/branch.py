@@ -71,9 +71,21 @@ class Branch:
         """Bring everything in `merge_frontier`'s history into this branch
         (reference: src/list/merge.rs:63-96).
 
-        Uses the C++ host core when built (same algorithm, ~2 orders of
-        magnitude faster); set DT_TPU_NO_NATIVE=1 to force the Python engine.
+        Backend selection behind this one boundary (the reference keeps
+        listmerge/listmerge2 behind the same seam):
+          * DT_TPU_DEVICE_MERGE=1 — device merge kernel (Fugue-tree
+            linearization of the conflict zone, batched-friendly),
+          * default — C++ host core when built (same algorithm as the
+            Python engine, ~2 orders of magnitude faster),
+          * DT_TPU_NO_NATIVE=1 — pure-Python engine (the oracle).
         """
+        if os.environ.get("DT_TPU_DEVICE_MERGE"):
+            from ..tpu.merge_kernel import merge_device
+            text, frontier = merge_device(oplog, self.version,
+                                          merge_frontier)
+            self.content = Rope(text)
+            self.version = frontier
+            return
         if not os.environ.get("DT_TPU_NO_NATIVE"):
             from ..native import merge_native, native_available
             if native_available():

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -48,6 +48,7 @@ class DeviceDoc:
     char_off: np.ndarray    # [n] int32 first char of run in `chars`
     chars: np.ndarray       # [pool] int32 char codes (prefix + ins arena)
     total_len: int          # expected document length
+    frontier: Optional[List[int]] = None  # version the checkout lands on
 
 
 def _agent_keys(oplog, lvs: np.ndarray):
@@ -81,13 +82,23 @@ def _arena_offsets(oplog, lvs: np.ndarray) -> np.ndarray:
     return cp0[j] + (lvs - lv0[j])
 
 
-def prepare_doc(oplog) -> DeviceDoc:
-    """Host pass: origins + char pool for a full checkout (from scratch)."""
+def prepare_doc(oplog, from_frontier: Sequence[int] = (),
+                merge_frontier: Optional[Sequence[int]] = None) -> DeviceDoc:
+    """Host pass: origins + char pool for a device checkout.
+
+    Generalizes to INCREMENTAL merge (reference: TransformedOpsIter::new
+    takes any `from` frontier, merge.rs:618): the tracker covers the
+    conflict zone of (from, merge), the underwater spine tiles the
+    document at the zone's common ancestor, and the produced checkout is
+    the document at version_union(from, merge) — which is exactly what a
+    branch at `from` merging `merge` must converge to."""
     from ..native.core import get_native_ctx
 
     ctx = get_native_ctx(oplog)
-    merge = [int(x) for x in oplog.version]
-    ctx.transform([], merge)
+    frm = [int(x) for x in from_frontier]
+    merge = ([int(x) for x in oplog.version] if merge_frontier is None
+             else [int(x) for x in merge_frontier])
+    *_rest, union = ctx.transform(frm, merge)
     ids, ln, ol, orr, st, ev = ctx.dump_tracker(keep_underwater=True)
     common = ctx.zone_common()
 
@@ -98,7 +109,7 @@ def prepare_doc(oplog) -> DeviceDoc:
     if len(ids) == 0:
         # no conflict zone at all (purely linear history): the document is
         # the fast-forward result; model it as one visible pseudo-run
-        prefix, _ = ctx.merge_to_string("", [], merge)
+        prefix, _ = ctx.merge_to_string("", [], union)
         ctx.release_tracker()
         arr = np.frombuffer(prefix.encode("utf-32-le"), dtype=np.int32)
         n = 1
@@ -111,7 +122,7 @@ def prepare_doc(oplog) -> DeviceDoc:
             vis_len=np.array([len(arr)], dtype=np.int32),
             char_off=np.zeros(n, dtype=np.int32),
             chars=arr if len(arr) else np.zeros(1, np.int32),
-            total_len=len(arr))
+            total_len=len(arr), frontier=union)
     if common:
         prefix, _ = ctx.merge_to_string("", [], common)
     else:
@@ -152,7 +163,8 @@ def prepare_doc(oplog) -> DeviceDoc:
         key_pos=kp.astype(np.int32),
         key_agent=ka.astype(np.int32), key_seq=ks.astype(np.int32),
         vis_len=vis.astype(np.int32), char_off=off.astype(np.int32),
-        chars=chars.astype(np.int32), total_len=int(vis.sum()))
+        chars=chars.astype(np.int32), total_len=int(vis.sum()),
+        frontier=union)
 
 
 def _checkout_kernel(parent, side, key_pos, key_agent, key_seq, vis_len,
@@ -185,6 +197,17 @@ def checkout_device(oplog, doc: Optional[DeviceDoc] = None) -> str:
     if doc is None:
         doc = prepare_doc(oplog)
     return checkout_batch_device([doc])[0]
+
+
+def merge_device(oplog, from_frontier: Sequence[int],
+                 merge_frontier: Optional[Sequence[int]] = None):
+    """Incremental device merge: the document + frontier a branch at
+    `from_frontier` reaches after merging `merge_frontier` (defaults to
+    the oplog tip). Returns (text, frontier) at version_union(from,
+    merge) — the convergence target of Branch.merge (reference:
+    src/list/merge.rs:63-96 via TransformedOpsIter::new(from, ...))."""
+    doc = prepare_doc(oplog, from_frontier, merge_frontier)
+    return checkout_batch_device([doc])[0], doc.frontier
 
 
 def pad_docs(docs: List[DeviceDoc]):
