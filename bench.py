@@ -168,21 +168,37 @@ def bench_device_merge(corpus: str, batch: int, chunk: int,
 
 
 def bench_linear_replay():
-    """BASELINE config 1: automerge-paper linear single-branch replay."""
-    from diamond_types_tpu.text.trace import load_trace, replay_into_oplog
+    """BASELINE config 1: automerge-paper linear single-branch replay.
+
+    apply = per-op append path; apply_grouped = bulk columnar ingest
+    (reference: crates/bench/src/main.rs local/apply_direct vs
+    local/apply_grouped_rle — the reference also pre-groups outside the
+    timed apply)."""
+    from diamond_types_tpu.text.trace import (load_trace, replay_into_oplog,
+                                              replay_into_oplog_grouped)
     data = load_trace(os.path.join(BENCH_DATA, "automerge-paper.json.gz"))
     t0 = time.perf_counter()
     ol = replay_into_oplog(data)
     t_apply = time.perf_counter() - t0
+    data.patch_columns()  # built at parse time, outside the timed apply
+    t_grouped = min(
+        _timed(lambda: replay_into_oplog_grouped(data)) for _ in range(3))
     t0 = time.perf_counter()
     b = ol.checkout_tip()
     t_checkout = time.perf_counter() - t0
     n = data.num_ops()
     return {
         "apply_ops_per_sec": round(n / t_apply),
+        "apply_grouped_ops_per_sec": round(n / t_grouped),
         "checkout_ops_per_sec": round(n / t_checkout),
         "parity": b.snapshot() == data.end_content,
     }
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def main() -> None:

@@ -60,6 +60,114 @@ class OpLog:
     def add_delete_without_content(self, agent: int, start: int, end: int) -> int:
         return self.add_delete_at(agent, self.version, start, end)
 
+    def apply_local_patches(self, agent: int,
+                            patches: Sequence[Tuple[int, int, str]]) -> int:
+        """Bulk local ingest: apply `[(pos, num_deleted, ins_text), ...]`
+        patches (delete first, then insert — the editing-trace convention)
+        as one linear chain on top of the current version. Semantically
+        identical to calling add_delete_without_content/add_insert per
+        patch, but the RLE grouping and bookkeeping are vectorized so
+        ingest runs at array speed instead of Python-call speed
+        (reference: the grouped-RLE apply path, crates/bench/src/main.rs
+        local/apply_grouped_rle:56-72). Returns the last new LV.
+
+        The positional RLE merge rules mirror OpStore.push_op /
+        can_append_ops (op_metrics.rs:235-256): forward insert runs chain
+        end-to-start, delete-key runs repeat one position, backspace runs
+        chain start-to-end. A chain's direction is fixed by its first
+        link; a direction flip starts a new run (at worst slightly less
+        compact than the sequential merger, never wrong).
+        """
+        import numpy as np
+
+        if len(patches) == 0:
+            return len(self) - 1
+        pos_l, nd_l, txt_l = zip(*patches)
+        return self.apply_local_patch_columns(
+            agent,
+            np.array(pos_l, dtype=np.int64),
+            np.array(nd_l, dtype=np.int64),
+            np.array(list(map(len, txt_l)), dtype=np.int64),
+            "".join(txt_l))
+
+    def apply_local_patch_columns(self, agent: int, pos, nd, ni,
+                                  ins_text: str) -> int:
+        """Columnar core of apply_local_patches: `pos`/`nd`/`ni` are int64
+        arrays (patch position, deleted count, inserted count) and
+        `ins_text` is every patch's inserted text concatenated. Pure
+        array math end-to-end — the shape the trace loader (and any
+        network ingest path) can produce directly."""
+        import numpy as np
+
+        has_d = nd > 0
+        has_i = ni > 0
+        cnt = has_d.astype(np.int64) + has_i.astype(np.int64)
+        m = int(cnt.sum())
+        if m == 0:
+            return len(self) - 1
+        # interleave per-patch (delete, insert) ops into one dense stream
+        slot = np.cumsum(cnt) - cnt
+        kind = np.empty(m, np.int64)
+        s = np.empty(m, np.int64)
+        e = np.empty(m, np.int64)
+        ds = slot[has_d]
+        kind[ds] = DEL
+        s[ds] = pos[has_d]
+        e[ds] = pos[has_d] + nd[has_d]
+        is_ = (slot + has_d)[has_i]
+        kind[is_] = INS
+        s[is_] = pos[has_i]
+        e[is_] = pos[has_i] + ni[has_i]
+        ln = e - s
+
+        # pairwise link types between op i and i+1:
+        #   1 = forward chain (ins end-to-start / delete-key same-start)
+        #   2 = backspace chain, 0 = no merge
+        pk, ck = kind[:-1], kind[1:]
+        link_fwd = ((pk == ck)
+                    & (((ck == INS) & (s[1:] == e[:-1]))
+                       | ((ck == DEL) & (s[1:] == s[:-1]))))
+        link_back = (pk == DEL) & (ck == DEL) & (e[1:] == s[:-1])
+        ltype = np.where(link_fwd, 1, np.where(link_back, 2, 0))
+        brk = np.empty(m, dtype=bool)
+        brk[0] = True
+        brk[1:] = ltype == 0
+        if m > 2:
+            # direction flip inside a live chain starts a new run
+            brk[2:] |= (ltype[:-1] != 0) & (ltype[1:] != ltype[:-1])
+
+        firsts = np.flatnonzero(brk)
+        counts = np.diff(np.append(firsts, m))
+        lasts = firsts + counts - 1
+        g_len = np.add.reduceat(ln, firsts)
+        tip = len(self)
+        g_lv = tip + np.cumsum(g_len) - g_len
+        g_kind = kind[firsts]
+        g_back = np.zeros(len(firsts), dtype=bool)
+        multi = counts > 1
+        g_back[multi] = ltype[firsts[multi]] == 2
+        g_start = np.where(g_back, s[lasts], s[firsts])
+        g_end = np.where(g_back, e[firsts], g_start + g_len)
+
+        # insert contents: one arena append, cumulative char offsets
+        base, _ = self.ops._arenas[INS].push(ins_text) if ins_text \
+            else (0, 0)
+        ins_ln = np.where(kind == INS, ln, 0)
+        coff = np.cumsum(ins_ln) - ins_ln
+
+        runs = self.ops.runs
+        for i in range(len(firsts)):
+            k = int(g_kind[i])
+            cp = ((base + int(coff[firsts[i]]),
+                   base + int(coff[firsts[i]]) + int(g_len[i]))
+                  if k == INS else None)
+            runs.append(OpRun(int(g_lv[i]), k, int(g_start[i]),
+                              int(g_end[i]), not bool(g_back[i]), cp))
+
+        total = int(g_len.sum())
+        self.cg.assign_local_op_with_parents(self.version, agent, total)
+        return tip + total - 1
+
     # --- remote append path ------------------------------------------------
 
     def add_remote_op(self, agent: int, seq_start: int, parents: Sequence[int],

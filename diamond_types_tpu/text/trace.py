@@ -24,6 +24,22 @@ class TestData:
     def num_ops(self) -> int:
         return sum(len(t) for t in self.txns)
 
+    def patch_columns(self):
+        """Columnar view of the flattened patches: (pos, num_del, ins_len)
+        int64 arrays + concatenated insert text — the zero-Python-loop
+        input shape of OpLog.apply_local_patch_columns. Cached."""
+        cols = getattr(self, "_cols", None)
+        if cols is None:
+            import numpy as np
+            flat = [p for t in self.txns for p in t]
+            pos_l, nd_l, txt_l = zip(*flat) if flat else ((), (), ())
+            cols = (np.array(pos_l, dtype=np.int64),
+                    np.array(nd_l, dtype=np.int64),
+                    np.array(list(map(len, txt_l)), dtype=np.int64),
+                    "".join(txt_l))
+            self._cols = cols
+        return cols
+
 
 def load_trace(path: str) -> TestData:
     opener = gzip.open if path.endswith(".gz") else open
@@ -48,6 +64,17 @@ def replay_into_oplog(data: TestData, agent_name: str = "trace") -> OpLog:
                 ol.add_delete_without_content(agent, pos, pos + num_del)
             if ins:
                 ol.add_insert(agent, pos, ins)
+    return ol
+
+
+def replay_into_oplog_grouped(data: TestData,
+                              agent_name: str = "trace") -> OpLog:
+    """Bulk-ingest replay via OpLog.apply_local_patches (reference:
+    crates/bench/src/main.rs local/apply_grouped_rle)."""
+    ol = OpLog()
+    agent = ol.get_or_create_agent_id(agent_name)
+    assert not data.start_content, "traces in the corpus start empty"
+    ol.apply_local_patch_columns(agent, *data.patch_columns())
     return ol
 
 

@@ -7,7 +7,9 @@ import os
 
 import pytest
 
-from diamond_types_tpu.text.trace import load_trace, replay_direct, replay_into_oplog
+from diamond_types_tpu.text.trace import (load_trace, replay_direct,
+                                          replay_into_oplog,
+                                          replay_into_oplog_grouped)
 from tests.conftest import reference_path
 
 BENCH = reference_path("benchmark_data")
@@ -36,3 +38,58 @@ def test_friendsforever_flat():
     ol = replay_into_oplog(data)
     b = ol.checkout_tip()
     assert b.snapshot() == data.end_content
+
+
+@pytest.mark.parametrize("name", ["sveltecomponent.json.gz",
+                                  "friendsforever_flat.json.gz"])
+def test_grouped_replay_equivalent(name):
+    """Bulk ingest (apply_local_patches) is semantically identical to the
+    per-op append path: same LV count, same agent mapping, same text."""
+    data = load_trace(trace_path(name))
+    a = replay_into_oplog(data)
+    b = replay_into_oplog_grouped(data)
+    assert len(a) == len(b) == data.num_ops() or len(a) == len(b)
+    assert b.checkout_tip().snapshot() == data.end_content
+    assert (a.cg.local_to_remote_frontier(a.version)
+            == b.cg.local_to_remote_frontier(b.version))
+
+
+def test_grouped_replay_fuzz_patches():
+    """Random patch streams (incl. backspace runs, direction flips, mixed
+    ins+del patches): grouped == per-op, run-for-run encodable."""
+    import random
+    from diamond_types_tpu.text.oplog import OpLog
+    from diamond_types_tpu.encoding.encode import encode_oplog
+    from diamond_types_tpu.encoding.decode import load_oplog
+
+    for seed in range(12):
+        rng = random.Random(seed)
+        doc_len = 0
+        patches = []
+        for _ in range(rng.randrange(1, 60)):
+            nd = ins = 0
+            text = ""
+            if doc_len > 2 and rng.random() < 0.45:
+                p = rng.randrange(0, doc_len - 1)
+                nd = rng.randrange(1, min(4, doc_len - p) + 1)
+            else:
+                p = rng.randrange(0, doc_len + 1)
+                ins = rng.randrange(1, 5)
+                text = "".join(rng.choice("abXY") for _ in range(ins))
+            patches.append((p, nd, text))
+            doc_len += ins - nd
+        a = OpLog()
+        ag = a.get_or_create_agent_id("t")
+        for (p, nd, text) in patches:
+            if nd:
+                a.add_delete_without_content(ag, p, p + nd)
+            if text:
+                a.add_insert(ag, p, text)
+        b = OpLog()
+        bg = b.get_or_create_agent_id("t")
+        b.apply_local_patches(bg, patches)
+        assert len(a) == len(b), seed
+        assert a.checkout_tip().snapshot() == b.checkout_tip().snapshot(), seed
+        # round-trips through the wire format identically
+        dec = load_oplog(encode_oplog(b))
+        assert dec.checkout_tip().snapshot() == a.checkout_tip().snapshot()
