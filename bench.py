@@ -87,12 +87,23 @@ def _run_device_bench(code: str, timeout: int):
     return {"ok": False, "why": f"exit {rc}", "tail": tail, **out}
 
 
-_TPU_BENCH_SNIPPET = """
-import sys, time
+# Shared snippet prelude: the environment's site hook force-initializes the
+# TPU backend inside jax.devices() regardless of JAX_PLATFORMS; honoring an
+# explicit env request via the config API (before backend init) keeps the
+# snippets smoke-testable on CPU while defaulting to the chip.
+_PRELUDE = """
+import sys, os, time
 sys.path.insert(0, {repo!r})
-import jax, jax.numpy as jnp
-from functools import partial
+import numpy as np
+import jax
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax.numpy as jnp
 print("PLATFORM", jax.devices()[0].platform, flush=True)
+"""
+
+_TPU_BENCH_SNIPPET = _PRELUDE + """
+from functools import partial
 from __graft_entry__ import _example_batch
 from diamond_types_tpu.tpu.batch import replay_batch
 batch, n_ops, cap = {batch}, {n_ops}, {cap}
@@ -117,12 +128,7 @@ def bench_tpu_batch(batch: int = 1024, n_ops: int = 256, cap: int = 1024,
     return _run_device_bench(code, timeout)
 
 
-_MERGE_KERNEL_SNIPPET = """
-import sys, time
-sys.path.insert(0, {repo!r})
-import numpy as np
-import jax, jax.numpy as jnp
-print("PLATFORM", jax.devices()[0].platform, flush=True)
+_MERGE_KERNEL_SNIPPET = _PRELUDE + """
 from diamond_types_tpu.encoding.decode import load_oplog
 from diamond_types_tpu.tpu.merge_kernel import (prepare_doc, pad_docs,
                                                 _jitted_kernel, _pow2)
@@ -164,6 +170,41 @@ def bench_device_merge(corpus: str, batch: int, chunk: int,
         repo=os.path.dirname(os.path.abspath(__file__)),
         data=os.path.join(BENCH_DATA, corpus),
         batch=batch, chunk=chunk)
+    return _run_device_bench(code, timeout)
+
+
+_FANIN_SNIPPET = _PRELUDE + """
+from diamond_types_tpu.causalgraph.graph import Graph
+from diamond_types_tpu.tpu import graph_kernels as gk
+n_rep, run_len = {n_rep}, 8
+g = Graph()
+for i in range(n_rep):
+    g.push([], i * run_len, (i + 1) * run_len)
+tip = n_rep * run_len
+g.push([(i + 1) * run_len - 1 for i in range(n_rep)], tip, tip + 4)
+packed = gk.pack_graph(g)
+n = packed["n"]
+reach0 = jnp.asarray(np.where(np.arange(n) == n - 1, tip + 3,
+                              -1).astype(np.int32))
+fn = jax.jit(lambda r0: gk.reach_fixed_point(packed, r0))
+reach = fn(reach0).block_until_ready()
+t0 = time.perf_counter()
+reach = fn(reach0).block_until_ready()
+dt = time.perf_counter() - t0
+reach = np.asarray(reach)
+assert (reach[:n_rep] == (np.arange(n_rep) + 1) * run_len - 1).all()
+print("RESULT", dt * 1e3)
+"""
+
+
+def bench_fanin_10k(n_rep: int = 10_000, timeout: int = 240):
+    """BASELINE config 5: 10k-replica fan-in causal-graph propagation
+    (CSR scatter-max fixed point) on the chip; reports wall-clock ms per
+    full propagation. The sharded (8-device) variant of the same kernel
+    is validated by tests/test_tpu_kernels.py::test_sharded_10k_replica_
+    fanin and the driver's multichip dryrun."""
+    code = _FANIN_SNIPPET.format(
+        repo=os.path.dirname(os.path.abspath(__file__)), n_rep=n_rep)
     return _run_device_bench(code, timeout)
 
 
@@ -239,6 +280,12 @@ def main() -> None:
         extra["device_platform"] = r.get("platform", "?")
     else:
         extra["tpu_batched_replay_error"] = r
+
+    r = bench_fanin_10k()
+    if r.get("ok"):
+        extra["fanin_10k_propagation_ms"] = round(r["value"], 3)
+    else:
+        extra["fanin_10k_error"] = r
 
     # Device merge kernel: primary corpus (git-makefile, BASELINE config 3)
     # plus the 2-agent and 1024-doc batch configs (2 and 4). Chunk sizes

@@ -47,33 +47,48 @@ def sharded_replay(mesh: Mesh, pos, dlen, ilen, chars, cap: int):
     return fn(pos, dlen, ilen, chars)
 
 
-def sharded_reach_fixed_point(mesh: Mesh, starts, parent_lv, parent_run,
-                              reach0):
-    """Causal-graph reachability with the run table sharded across devices.
+def pad_edges(packed: dict, n_devices: int):
+    """Pad a pack_graph CSR edge list to a multiple of n_devices.
 
-    Each device owns a contiguous slice of runs. One round = local scatter-max
-    relaxation + all-reduce(max) of the global reach vector over ICI. Rounds
-    iterate to a fixed point (device analogue of the cross-shard frontier
-    propagation described in SURVEY.md §2.9).
+    Padding edges scatter to the drop slot (prun == n) with a -1 LV, so
+    they are inert regardless of activity. Returns (src, plv, prun) numpy
+    arrays ready to shard."""
+    n, m = packed["n"], packed["m"]
+    pad_to = max(n_devices, ((m + n_devices - 1) // n_devices) * n_devices)
+    src = np.zeros(pad_to, dtype=np.int32)
+    plv = np.full(pad_to, -1, dtype=np.int32)
+    prun = np.full(pad_to, n, dtype=np.int32)
+    src[:m] = np.asarray(packed["edge_src"])
+    plv[:m] = np.asarray(packed["edge_plv"])
+    prun[:m] = np.asarray(packed["edge_prun"])
+    return src, plv, prun
 
-    starts: int64 [n]; parent_lv: int64 [n, k]; parent_run: int32 [n, k]
-    (global run indices, n = pad); reach0: int64 [n].
+
+def sharded_reach_fixed_point(mesh: Mesh, starts, edge_src, edge_plv,
+                              edge_prun, reach0):
+    """Causal-graph reachability with the EDGE list sharded across devices.
+
+    Each device owns a contiguous slice of (run, parent) edges; the reach
+    vector is replicated. One round = local scatter-max relaxation +
+    all-reduce(max) over ICI. Rounds iterate to a fixed point (the
+    cross-shard frontier propagation of SURVEY.md §2.9). Edge sharding —
+    not run sharding — keeps a 10k-way fan-in merge balanced: its 10k
+    edges spread evenly over the mesh instead of landing on one run's
+    device.
+
+    starts: int32 [n]; edge_*: int32 [m] (m divisible by the mesh size,
+    see pad_edges); reach0: int32 [n].
     """
     n = starts.shape[0]
     axis = mesh.axis_names[0]
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis, None), P(axis, None), P(None)),
+             in_specs=(P(None), P(axis), P(axis), P(axis), P(None)),
              out_specs=P(None))
-    def one_round(starts_l, plv_l, prun_l, reach):
-        # Local slice: which of my runs are active?
-        shard_i = jax.lax.axis_index(axis)
-        per = starts_l.shape[0]
-        offset = shard_i * per
-        my_reach = jax.lax.dynamic_slice(reach, (offset,), (per,))
-        active = my_reach >= starts_l
-        contrib = jnp.where(active[:, None], plv_l, -1).reshape(-1)
-        tgt = jnp.where(active[:, None], prun_l, jnp.int32(n)).reshape(-1)
+    def one_round(starts_r, src_l, plv_l, prun_l, reach):
+        active = (reach >= starts_r)[src_l]
+        contrib = jnp.where(active, plv_l, -1)
+        tgt = jnp.where(active, prun_l, jnp.int32(n))
         upd = jnp.full((n,), -1, dtype=reach.dtype).at[tgt].max(
             contrib, mode="drop")
         # Exchange shard contributions over ICI.
@@ -85,7 +100,7 @@ def sharded_reach_fixed_point(mesh: Mesh, starts, parent_lv, parent_run,
 
     def body(state):
         reach, _ = state
-        new = one_round(starts, parent_lv, parent_run, reach)
+        new = one_round(starts, edge_src, edge_plv, edge_prun, reach)
         return new, jnp.any(new != reach)
 
     reach, _ = jax.lax.while_loop(cond, body, (reach0, jnp.array(True)))
@@ -93,12 +108,12 @@ def sharded_reach_fixed_point(mesh: Mesh, starts, parent_lv, parent_run,
 
 
 def multichip_merge_step(mesh: Mesh, pos, dlen, ilen, chars, cap: int,
-                         starts, parent_lv, parent_run, reach0):
+                         starts, edge_src, edge_plv, edge_prun, reach0):
     """One full sharded "step": sharded multi-doc replay (data parallel) +
     sharded causal-graph propagation (graph parallel with collectives).
     This is the step that `__graft_entry__.dryrun_multichip` jits over an
     n-device mesh."""
     docs, lens = sharded_replay(mesh, pos, dlen, ilen, chars, cap)
-    reach = sharded_reach_fixed_point(mesh, starts, parent_lv, parent_run,
-                                      reach0)
+    reach = sharded_reach_fixed_point(mesh, starts, edge_src, edge_plv,
+                                      edge_prun, reach0)
     return docs, lens, reach

@@ -32,23 +32,29 @@ import jax.numpy as jnp
 
 
 def pack_graph(graph) -> dict:
-    """Export a host Graph into padded dense device arrays."""
+    """Export a host Graph into CSR edge arrays (fully vectorized).
+
+    Edge-parallel layout: one row per (run, parent) edge. A 10k-way
+    fan-in merge is 10k edge rows — NOT a [n, 10k] padded parent matrix
+    (the round-1 dense layout could not scale to BASELINE config 5).
+    Device math is int32; LV bounds are validated here, loudly."""
     starts, ends, shadows, indptr, flat = graph.as_arrays()
     n = len(starts)
-    max_p = max(1, int(max((indptr[i + 1] - indptr[i] for i in range(n)),
-                           default=0)))
-    plv = np.full((n, max_p), -1, dtype=np.int32)   # parent LVs
-    pent = np.full((n, max_p), n, dtype=np.int32)   # parent run idx (n = pad)
-    for i in range(n):
-        for j, p in enumerate(flat[indptr[i]:indptr[i + 1]]):
-            plv[i, j] = int(p)
-            pent[i, j] = graph.find_idx(int(p))
+    assert ends.max(initial=0) < 2**31 - 1, \
+        "graph LVs exceed int32 device math — widen the kernels first"
+    counts = np.diff(indptr)
+    m = int(flat.shape[0])
+    src = np.repeat(np.arange(n, dtype=np.int32), counts)
+    plv = flat.astype(np.int32)
+    prun = (np.searchsorted(starts, flat, side="right") - 1).astype(np.int32)
     return {
         "starts": jnp.asarray(starts.astype(np.int32)),
         "ends": jnp.asarray(ends.astype(np.int32)),
-        "parent_lv": jnp.asarray(plv.astype(np.int32)),
-        "parent_run": jnp.asarray(pent),
+        "edge_src": jnp.asarray(src),    # [m] run owning the edge
+        "edge_plv": jnp.asarray(plv),    # [m] parent LV
+        "edge_prun": jnp.asarray(prun),  # [m] run containing the parent
         "n": n,
+        "m": m,
     }
 
 
@@ -63,18 +69,17 @@ def reach_fixed_point(packed: dict, reach0: jnp.ndarray) -> jnp.ndarray:
     Returns reach: highest LV of each run that is an ancestor of the seed set.
     """
     starts = packed["starts"]
-    parent_lv = packed["parent_lv"]      # [n, k]
-    parent_run = packed["parent_run"]    # [n, k]
+    src = packed["edge_src"]        # [m]
+    plv = packed["edge_plv"]        # [m]
+    prun = packed["edge_prun"]      # [m]
     n = packed["n"]
 
     def body(state):
         reach, _ = state
-        active = reach >= starts                       # [n]
-        contrib = jnp.where(active[:, None], parent_lv, -1)  # [n, k]
-        tgt = jnp.where(active[:, None], parent_run,
-                        jnp.int32(n))                  # [n, k]
-        new_reach = reach.at[tgt.reshape(-1)].max(
-            contrib.reshape(-1), mode="drop")
+        active = (reach >= starts)[src]                # [m]
+        contrib = jnp.where(active, plv, -1)
+        tgt = jnp.where(active, prun, jnp.int32(n))
+        new_reach = reach.at[tgt].max(contrib, mode="drop")
         return new_reach, jnp.any(new_reach != reach)
 
     reach, _ = jax.lax.while_loop(

@@ -118,33 +118,63 @@ def test_sharded_replay_8_devices():
 
 
 def test_sharded_graph_propagation():
-    from diamond_types_tpu.parallel.mesh import (make_mesh,
+    from diamond_types_tpu.parallel.mesh import (make_mesh, pad_edges,
                                                  sharded_reach_fixed_point)
-    # Fan-in DAG: 16 root runs all merged by one run; pad to multiple of 8.
+    # Fan-in DAG: 16 root runs all merged by one run.
     g = Graph()
     for i in range(16):
         g.push([], i * 10, i * 10 + 10)
     g.push([i * 10 + 9 for i in range(16)], 160, 170)
-    # Pad runs to 24 (divisible by 8) with self-contained dummies.
     packed = gk.pack_graph(g)
     n = packed["n"]
-    pad_to = 24
-    starts = np.full((pad_to,), 2**31 - 1, dtype=np.int32)
-    starts[:n] = np.asarray(packed["starts"])
-    k = packed["parent_lv"].shape[1]
-    plv = np.full((pad_to, k), -1, dtype=np.int32)
-    plv[:n] = np.asarray(packed["parent_lv"])
-    prun = np.full((pad_to, k), pad_to, dtype=np.int32)
-    prun[:n] = np.minimum(np.asarray(packed["parent_run"]), pad_to)
-    reach0 = np.full((pad_to,), -1, dtype=np.int32)
+    src, plv, prun = pad_edges(packed, 8)
+    reach0 = np.full((n,), -1, dtype=np.int32)
     reach0[16] = 169  # frontier at the merge tip
 
     mesh = make_mesh(8, axis="graph")
     reach = np.asarray(sharded_reach_fixed_point(
-        mesh, jnp.asarray(starts), jnp.asarray(plv), jnp.asarray(prun),
-        jnp.asarray(reach0)))
+        mesh, packed["starts"], jnp.asarray(src), jnp.asarray(plv),
+        jnp.asarray(prun), jnp.asarray(reach0)))
     # Every root run must be fully covered.
     assert all(reach[i] == i * 10 + 9 for i in range(16)), reach[:17]
+
+
+def _fanin_graph(n_replicas: int, run_len: int = 8):
+    """BASELINE config 5 shape: n_replicas concurrent root runs, one
+    fan-in merge tip naming every replica's last LV as a parent."""
+    g = Graph()
+    for i in range(n_replicas):
+        g.push([], i * run_len, (i + 1) * run_len)
+    tip = n_replicas * run_len
+    g.push([(i + 1) * run_len - 1 for i in range(n_replicas)], tip, tip + 4)
+    return g, tip
+
+
+def test_sharded_10k_replica_fanin():
+    """The 10k-replica fan-in graph (BASELINE config 5) on the 8-device
+    mesh: 10k edges shard evenly (edge-parallel CSR — the round-1 dense
+    [n, max_parents] layout was O(n * 10k) memory and could not run)."""
+    from diamond_types_tpu.parallel.mesh import (make_mesh, pad_edges,
+                                                 sharded_reach_fixed_point)
+    n_rep = 10_000
+    g, tip = _fanin_graph(n_rep)
+    packed = gk.pack_graph(g)
+    assert packed["m"] == n_rep
+    n = packed["n"]
+    src, plv, prun = pad_edges(packed, 8)
+    reach0 = np.full((n,), -1, dtype=np.int32)
+    reach0[n - 1] = tip + 3
+
+    mesh = make_mesh(8, axis="graph")
+    reach = np.asarray(sharded_reach_fixed_point(
+        mesh, packed["starts"], jnp.asarray(src), jnp.asarray(plv),
+        jnp.asarray(prun), jnp.asarray(reach0)))
+    assert (reach[:n_rep] == np.arange(1, n_rep + 1) * 8 - 1).all()
+
+    # single-chip kernel agrees
+    reach1 = np.asarray(gk.reach_fixed_point(
+        packed, jnp.asarray(reach0)))
+    assert (reach1 == reach).all()
 
 
 def test_pallas_replay_matches_xla_path():
