@@ -48,7 +48,7 @@ def bench_merge(name: str, repeats: int = 3):
             snap = b.snapshot()
         else:
             assert snap == b.snapshot(), "non-deterministic merge!"
-    return n_ops, best, snap
+    return n_ops, best, snap, ol
 
 
 def _run_device_bench(code: str, timeout: int):
@@ -243,13 +243,29 @@ def _timed(fn):
 
 
 def main() -> None:
-    n_ops, best, _snap = bench_merge("git-makefile.dt")
+    from diamond_types_tpu.native.core import (native_counters,
+                                               reset_native_counters)
+    from diamond_types_tpu.utils.stats import oplog_stats
+
+    reset_native_counters()
+    n_ops, best, _snap, gm_ol = bench_merge("git-makefile.dt")
     ops_per_sec = n_ops / best
     host_ops = {"git-makefile.dt": ops_per_sec}
 
     extra = {}
+    # Structured observability for the primary corpus: per-structure RLE
+    # size/compaction breakdown + merge-kernel event counters (reference:
+    # print_stats, src/list/oplog.rs:353-405; counters per SURVEY §5).
     try:
-        ff_ops, ff_t, ff_snap = bench_merge("friendsforever.dt", repeats=1)
+        extra["stats"] = oplog_stats(gm_ol, include_encoded_sizes=True)
+        c = native_counters()
+        if c is not None:
+            extra["native_merge_counters"] = c
+    except Exception as e:  # pragma: no cover
+        extra["stats_error"] = str(e)[:100]
+
+    try:
+        ff_ops, ff_t, ff_snap, _ = bench_merge("friendsforever.dt", repeats=1)
         import gzip
         import json as _json
         with gzip.open(os.path.join(BENCH_DATA,
@@ -263,7 +279,7 @@ def main() -> None:
         extra["friendsforever_error"] = str(e)[:100]
 
     try:
-        nn_ops, nn_t, _ = bench_merge("node_nodecc.dt", repeats=2)
+        nn_ops, nn_t, _, _ = bench_merge("node_nodecc.dt", repeats=2)
         extra["node_nodecc_ops_per_sec"] = round(nn_ops / nn_t)
         host_ops["node_nodecc.dt"] = nn_ops / nn_t
     except Exception as e:  # pragma: no cover

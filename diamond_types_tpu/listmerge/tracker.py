@@ -25,6 +25,7 @@ from typing import List, Optional, Tuple
 
 from ..core.span import UNDERWATER_START
 from ..text.op import DEL, INS, OpRun
+from ..utils.stats import GLOBAL_COUNTERS as COUNTERS
 
 ROOT = -1
 
@@ -303,6 +304,7 @@ class Tracker:
         merge.rs:154-278). Returns the item's transformed (upstream) insert
         position. `cursor` sits immediately after the item's origin_left.
         """
+        COUNTERS.bump("integrate_calls")
         cursor = self._roll(cursor) if cursor is not None else None
         left_cursor = cursor
         scan_start = cursor
@@ -384,6 +386,7 @@ class Tracker:
         when the delete already happened (reference: merge.rs:375-558).
         """
         length = min(max_len, len(op))
+        COUNTERS.bump("apply_ins_runs" if op.kind == INS else "apply_del_runs")
         if op.kind == INS:
             if not op.fwd:
                 raise NotImplementedError("reverse insert runs")
@@ -496,6 +499,7 @@ class Tracker:
 
     def advance_by_range(self, rng: Tuple[int, int]) -> None:
         """Re-apply op effects for LVs in `rng` (reference: advance_retreat.rs:58-97)."""
+        COUNTERS.bump("advance_calls")
         start, end = rng
         while start < end:
             kind, target, offset, total = self._index_query(start)
@@ -507,6 +511,7 @@ class Tracker:
     def retreat_by_range(self, rng: Tuple[int, int]) -> None:
         """Un-apply op effects for LVs in `rng`, back to front so un-deletes
         precede un-inserts of the same item (reference: advance_retreat.rs:100-153)."""
+        COUNTERS.bump("retreat_calls")
         start, end = rng
         while start < end:
             req = end - 1

@@ -78,6 +78,10 @@ def _load():
         ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
     lib.dt_get_zone_common.restype = ct.c_int64
     lib.dt_release_tracker.argtypes = [ct.c_void_p]
+    lib.dt_get_counters.argtypes = [
+        np.ctypeslib.ndpointer(np.uint64, flags="C"), ct.c_int64]
+    lib.dt_get_counters.restype = ct.c_int64
+    lib.dt_reset_counters.argtypes = []
     _lib = lib
     return lib
 
@@ -237,6 +241,31 @@ class NativeContext:
             fbuf = np.empty(k, dtype=np.int64)
             lib.dt_get_out_frontier(self._ptr, fbuf, k)
         return doc, [int(x) for x in fbuf[:k]]
+
+
+# Order mirrors dt_core.cpp's EventCounters / dt_get_counters.
+EVENT_COUNTER_NAMES = (
+    "integrate_calls", "integrate_scan_iters", "apply_ins_runs",
+    "apply_del_runs", "advance_calls", "retreat_calls", "walk_steps",
+    "diff_calls")
+
+
+def native_counters() -> Optional[dict]:
+    """Process-global merge-kernel event counters from the C++ engine
+    (SURVEY §5 structured counters; always on)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.zeros(len(EVENT_COUNTER_NAMES), dtype=np.uint64)
+    k = lib.dt_get_counters(buf, len(buf))
+    return {n: int(buf[i])
+            for i, n in enumerate(EVENT_COUNTER_NAMES[:int(k)])}
+
+
+def reset_native_counters() -> None:
+    lib = _load()
+    if lib is not None:
+        lib.dt_reset_counters()
 
 
 def get_native_ctx(oplog) -> "NativeContext":

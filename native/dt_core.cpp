@@ -40,6 +40,18 @@ static long g_diff_calls = 0, g_diff_iters = 0;
 long g_walk_steps = 0, g_walk_zero = 0, g_diff_iters2 = 0;
 #endif
 
+// Always-on structured event counters around the merge kernel (SURVEY §5:
+// the reference sketches these in its hot loops, merge.rs:311-314 /
+// advance_retreat.rs:73-76; here they ship enabled — plain increments cost
+// nothing next to the work they count). Exported via dt_get_counters; the
+// name order is mirrored by native/core.py EVENT_COUNTER_NAMES.
+struct EventCounters {
+  unsigned long long integrate_calls = 0, integrate_scan_iters = 0,
+      apply_ins_runs = 0, apply_del_runs = 0, advance_calls = 0,
+      retreat_calls = 0, walk_steps = 0, diff_calls = 0;
+};
+static EventCounters g_events;
+
 struct Span { i64 start, end; };
 
 static inline bool span_empty(const Span& s) { return s.end <= s.start; }
@@ -152,6 +164,7 @@ struct Graph {
                  std::vector<Span>& only_a, std::vector<Span>& only_b) const {
     // max-heap of (lv, flag)
     std::vector<std::pair<i64, u8>>& q = diff_heap;
+    g_events.diff_calls++;
 #ifdef DT_PROF
     g_diff_calls++;
 #endif
@@ -1176,6 +1189,7 @@ struct Tracker {
   // through the scan so the final position needs no tree climb.
   i64 integrate(const Agents& aa, i64 agent, const BEntry& item,
                 Cursor cursor, i64 up) {
+    g_events.integrate_calls++;
     // roll, accumulating crossed entries into the upstream prefix
     auto roll_up = [&](Cursor& c) -> bool {
       if (!c.leaf) return false;
@@ -1198,6 +1212,7 @@ struct Tracker {
     bool scanning = false;
 
     while (!at_end && cursor.leaf) {
+      g_events.integrate_scan_iters++;
       if (!roll_up(cursor)) break;
       const BEntry& other = cursor.leaf->e[cursor.idx];
       i64 off = cursor.off;
@@ -1454,6 +1469,7 @@ struct Tracker {
 #endif
 
   void advance_by_range(Span rng) {
+    g_events.advance_calls++;
     i64 start = rng.start, end = rng.end;
     while (start < end) {
       QueryRes q = index_query(start);
@@ -1466,6 +1482,7 @@ struct Tracker {
   }
 
   void retreat_by_range(Span rng) {
+    g_events.retreat_calls++;
     i64 start = rng.start, end = rng.end;
     while (start < end) {
       i64 req = end - 1;
@@ -1684,6 +1701,7 @@ struct Zone {
                   std::vector<int32_t>& retreat_i,
                   std::vector<int32_t>& advance_i) {
     enum : u8 { A = 0, B = 1, Shared = 2 };
+    g_events.walk_steps++;
 #ifdef DT_PROF
     extern long g_walk_steps, g_walk_zero, g_diff_iters2;
     g_walk_steps++;
@@ -1915,8 +1933,8 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
         alen = c->aa.span_len(piece.lv, plen);
       }
       std::pair<i64,i64> r;
-      if (piece.kind == INS) { PROF(apply_ins); r = tracker.apply(c->aa, agent, piece, alen); }
-      else { PROF(apply_del); r = tracker.apply(c->aa, agent, piece, alen); }
+      if (piece.kind == INS) { PROF(apply_ins); g_events.apply_ins_runs++; r = tracker.apply(c->aa, agent, piece, alen); }
+      else { PROF(apply_del); g_events.apply_del_runs++; r = tracker.apply(c->aa, agent, piece, alen); }
       auto [consumed, xf] = r;
 #ifdef DT_CHECK
       fprintf(stderr, "applied lv=%lld len=%lld kind=%d\n",
@@ -2183,5 +2201,20 @@ i64 dt_get_out_frontier(void* p, i64* buf, i64 cap) {
   for (i64 i = 0; i < n; i++) buf[i] = c->out_frontier[i];
   return (i64)c->out_frontier.size();
 }
+
+// Structured merge-kernel event counters (process-global; order matches
+// native/core.py EVENT_COUNTER_NAMES). Returns the counter count.
+i64 dt_get_counters(unsigned long long* out, i64 cap) {
+  const unsigned long long vals[] = {
+      g_events.integrate_calls, g_events.integrate_scan_iters,
+      g_events.apply_ins_runs, g_events.apply_del_runs,
+      g_events.advance_calls, g_events.retreat_calls,
+      g_events.walk_steps, g_events.diff_calls};
+  i64 k = (i64)(sizeof(vals) / sizeof(vals[0]));
+  for (i64 i = 0; i < std::min(cap, k); i++) out[i] = vals[i];
+  return k;
+}
+
+void dt_reset_counters() { g_events = EventCounters{}; }
 
 }  // extern "C"
