@@ -6,12 +6,13 @@ Primary metric (BASELINE.json): ops/sec merged on git-makefile.dt
 checkouts must agree byte-for-byte; friendsforever.dt must match the
 reference's flattened trace).
 
-vs_baseline: ratio against the only absolute throughput number stored in the
-reference repo — 12 ms for a full 259,778-op replay of automerge-paper
-(reference: crates/bench/src/main.rs:56-58) ≈ 21.6M ops/s on the author's
-machine. The reference's criterion harness can't be re-run here (no Rust
-toolchain in this image, zero egress to install one — see BASELINE.md
-"Measured locally"), so this is the documented stand-in baseline.
+vs_baseline: ratio against the MEASURED local baseline (BASELINE.md
+"Measured locally"): the C++ host engine's round-2 git-makefile merge
+throughput on this machine, frozen at LOCAL_BASELINE_OPS_PER_SEC. The
+reference's own criterion harness can't be re-run here (no Rust
+toolchain in this image, zero egress to install one); the author's
+published 12 ms automerge-paper replay figure is reported only as
+context in extra.vs_published_replay_figure.
 
 Device benches run in subprocesses with hard timeouts; every failure mode
 (init hang, timeout, OOM, parity assert) is reported EXPLICITLY in the
@@ -26,7 +27,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BASELINE_OPS_PER_SEC = 259_778 / 0.012  # reference replay figure (see above)
+# Round-2 measured local baseline: C++ host engine, git-makefile.dt merge,
+# this machine (see BASELINE.md "Measured locally" for the full table).
+LOCAL_BASELINE_OPS_PER_SEC = 27_171_331
+# The reference author's published replay figure (unspecified hardware),
+# kept as context only: crates/bench/src/main.rs:56-58.
+PUBLISHED_REPLAY_OPS_PER_SEC = 259_778 / 0.012
 
 BENCH_DATA = "/root/reference/benchmark_data"
 
@@ -320,11 +326,13 @@ def main() -> None:
         else:
             extra[f"tpu_merge_{key}_error"] = r
 
+    extra["vs_published_replay_figure"] = round(
+        ops_per_sec / PUBLISHED_REPLAY_OPS_PER_SEC, 4)
     print(json.dumps({
         "metric": "git-makefile.dt merge throughput",
         "value": round(ops_per_sec),
         "unit": "ops/sec",
-        "vs_baseline": round(ops_per_sec / BASELINE_OPS_PER_SEC, 4),
+        "vs_baseline": round(ops_per_sec / LOCAL_BASELINE_OPS_PER_SEC, 4),
         "extra": extra,
     }))
 
