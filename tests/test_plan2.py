@@ -1,0 +1,145 @@
+"""Fork/join plan engine (listmerge2 re-expression) vs the M1 engine —
+the reference's cross-engine differential strategy (reference:
+src/listmerge2/test_conversion.rs validates MergePlans against listmerge)."""
+
+import os
+
+import pytest
+
+from diamond_types_tpu.listmerge.dense import (DenseExecutor, apply_xf_stream,
+                                               merge_via_plan2)
+from diamond_types_tpu.listmerge.plan2 import (APPLY, BEGIN, FORK, MAX,
+                                               compile_plan2, validate_plan2)
+from tests.test_encode import build_random_oplog
+from tests.test_linearize import _fuzz_oplog
+
+
+def _checkout_text_plan2(ol, frontier=None):
+    rows, final = merge_via_plan2(ol, [], frontier or ol.version,
+                                  validate=True)
+    return apply_xf_stream(ol, "", rows), final
+
+
+# ---- plan structure ------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(20))
+def test_plan2_validates(seed):
+    ol = build_random_oplog(seed, steps=45)
+    plan = compile_plan2(ol.cg.graph, [], ol.version)
+    validate_plan2(plan)
+    assert plan.num_ops() == len(ol)
+
+
+def test_plan2_linear_history_is_pure_ff():
+    from diamond_types_tpu.text.oplog import OpLog
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    v = []
+    for i, ch in enumerate("hello"):
+        v = [ol.add_insert_at(a, v, i, ch)]
+    plan = compile_plan2(ol.cg.graph, [], ol.version)
+    assert plan.entries == [] and plan.actions == []
+    assert sum(b - a for (a, b) in plan.ff_spans) == 5
+
+
+def test_plan2_fork_join_shape():
+    """A 2-way concurrent edit produces a fork or two Begins plus a Max."""
+    from diamond_types_tpu.text.oplog import OpLog
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    base = [ol.add_insert_at(a, [], 0, "X")]
+    va = [ol.add_insert_at(a, base, 1, "a")]
+    vb = [ol.add_insert_at(b, base, 1, "b")]
+    merge = ol.cg.graph.version_union(va, vb)
+    plan = compile_plan2(ol.cg.graph, [], merge)
+    validate_plan2(plan)
+    kinds = [act[0] for act in plan.actions]
+    assert kinds.count(APPLY) == len(plan.entries)
+    assert FORK in kinds or kinds.count(BEGIN) >= 2
+    assert MAX not in kinds or plan.indexes_used >= 2
+
+
+# ---- differential parity vs M1 ------------------------------------------
+
+@pytest.mark.parametrize("seed", range(25))
+def test_plan2_checkout_matches_m1(seed):
+    ol = build_random_oplog(seed, steps=45)
+    expected = ol.checkout_tip().snapshot()
+    got, final = _checkout_text_plan2(ol)
+    assert got == expected
+    assert final == ol.version
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_plan2_incremental_matches_m1(seed):
+    ol = build_random_oplog(100 + seed, steps=35)
+    mid = ol.cg.graph.find_dominators([len(ol) // 2])
+    base = ol.checkout(mid)
+    m1 = ol.checkout(mid)
+    m1.merge(ol, ol.version)
+    rows, final = merge_via_plan2(ol, mid, ol.version, validate=True)
+    got = apply_xf_stream(ol, base.snapshot(), rows)
+    assert got == m1.snapshot()
+    assert final == m1.version
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_plan2_cross_sync_fuzz(seed):
+    """The hard shape: origins that are themselves tie-broken concurrent
+    inserts (mid-run oplog exchange between peers)."""
+    ol = _fuzz_oplog(seed, steps=30, cross_sync=True)
+    expected = ol.checkout_tip().snapshot()
+    got, final = _checkout_text_plan2(ol)
+    assert got == expected
+    assert final == ol.version
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_plan2_random_from_merge_pairs(seed):
+    """Arbitrary (from, merge) frontier pairs — the incremental-merge shape
+    the device path also has to serve (reference: merge.rs:618
+    TransformedOpsIter::new takes `from`)."""
+    import random
+    ol = _fuzz_oplog(200 + seed, steps=25, cross_sync=True)
+    rng = random.Random(seed)
+    for _ in range(4):
+        lv_a = rng.randrange(len(ol))
+        from_f = ol.cg.graph.find_dominators([lv_a])
+        merge_f = ol.version if rng.random() < 0.5 else \
+            ol.cg.graph.find_dominators(
+                [rng.randrange(len(ol)), len(ol) - 1])
+        base = ol.checkout(from_f)
+        m1 = ol.checkout(from_f)
+        m1.merge(ol, merge_f)
+        rows, final = merge_via_plan2(ol, from_f, merge_f, validate=True)
+        got = apply_xf_stream(ol, base.snapshot(), rows)
+        assert got == m1.snapshot()
+        assert final == m1.version
+
+
+def test_plan2_is_static_schedule():
+    ol = build_random_oplog(7, steps=40)
+    plan = compile_plan2(ol.cg.graph, [], ol.version)
+    r1 = [(lv, pos) for (lv, _o, pos) in
+          DenseExecutor(plan, ol.cg.agent_assignment, ol.ops).run()]
+    r2 = [(lv, pos) for (lv, _o, pos) in
+          DenseExecutor(plan, ol.cg.agent_assignment, ol.ops).run()]
+    assert r1 == r2
+
+
+# ---- shipped corpora -----------------------------------------------------
+
+def _reference_path(*parts):
+    return os.path.join("/root/reference", *parts)
+
+
+def test_plan2_friendsforever_corpus():
+    from diamond_types_tpu.encoding.decode import load_oplog
+    with open(_reference_path("benchmark_data", "friendsforever.dt"),
+              "rb") as f:
+        ol = load_oplog(f.read())
+    expected = ol.checkout_tip().snapshot()
+    got, final = _checkout_text_plan2(ol)
+    assert got == expected
+    assert final == ol.version
