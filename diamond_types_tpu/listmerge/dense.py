@@ -78,11 +78,21 @@ class _Cur:
 
 
 class DenseExecutor:
-    def __init__(self, plan: MergePlan2, aa, ops) -> None:
+    def __init__(self, plan: MergePlan2, aa, ops,
+                 journal: bool = False) -> None:
         self.plan = plan
         self.aa = aa
         self.ops = ops
         self.n_idx = max(1, plan.indexes_used)
+        # Optional effect journal for the device tier: per entry, the list
+        # of (id_lo, id_hi, state) writes its Apply performed, in item-id
+        # space AT WRITE TIME — the data the TPU plan executor replays (see
+        # tpu/plan_kernels.py). Ranges subsume split inheritance: a later
+        # split only refines slots WITHIN an already-journaled range, and
+        # states are monotone, so replaying ranges over the final slot
+        # table reproduces every snapshot exactly.
+        self.journal = [] if journal else None
+        self._cur_writes = None
         cap = 64
         self.S = np.zeros((cap, self.n_idx), dtype=np.uint8)
         self.is_base = np.zeros(cap, dtype=bool)
@@ -387,6 +397,8 @@ class DenseExecutor:
             new_sid = self._new_slot(op.lv, op.lv + length,
                                      origin_left, origin_right, False)
             self.S[new_sid, row] = INSERTED
+            if self._cur_writes is not None:
+                self._cur_writes.append((op.lv, op.lv + length, INSERTED))
             ins_pos, after = self._integrate(agent, new_sid, cursor)
             self._cur = after  # sequential typing lands right here next
             return length, ins_pos
@@ -420,6 +432,8 @@ class DenseExecutor:
                 rid = self._split(sid, take)
                 self.order.insert(c.oi + 1, rid)
             self.S[sid, row] = DELETED
+            if self._cur_writes is not None:
+                self._cur_writes.append((s.ids, s.ide, DELETED))
             s.ever = True
             if not fwd:
                 assert take == take_req
@@ -448,6 +462,9 @@ class DenseExecutor:
                 pass
             elif kind == APPLY:
                 entry = plan.entries[act[1]]
+                if self.journal is not None:
+                    self._cur_writes = []
+                    self.journal.append(self._cur_writes)
                 if act[2] != self._row:
                     self._row = act[2]
                     self._cur = None  # cached prefixes are per-row

@@ -66,6 +66,7 @@ class MergePlan2:
     indexes_used: int = 0
     ff_spans: List[Span] = field(default_factory=list)
     final_frontier: List[int] = field(default_factory=list)
+    common: List[int] = field(default_factory=list)  # zone common ancestor
 
     def num_ops(self) -> int:
         n = sum(b - a for (a, b) in self.ff_spans)
@@ -197,7 +198,7 @@ def compile_plan2(graph: Graph, from_frontier: List[int],
         target = new_ops if flag == DiffFlag.ONLY_B else conflict_ops
         push_reversed_rle(target, span)
 
-    graph.find_conflicting(from_frontier, merge_frontier, visit)
+    common = graph.find_conflicting(from_frontier, merge_frontier, visit)
     next_frontier = list(from_frontier)
 
     # Fast-forward prefix (linear history streams through untransformed).
@@ -223,8 +224,10 @@ def compile_plan2(graph: Graph, from_frontier: List[int],
                 if flag != DiffFlag.ONLY_B:
                     push_reversed_rle(conflict_ops, span)
 
-            graph.find_conflicting(next_frontier, merge_frontier, visit2)
+            common = graph.find_conflicting(next_frontier, merge_frontier,
+                                            visit2)
 
+        plan.common = list(common)
         zone = sorted([(tuple(s), False) for s in conflict_ops] +
                       [(tuple(s), True) for s in new_ops])
         entries = _build_subgraph(graph, zone)

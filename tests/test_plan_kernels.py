@@ -1,0 +1,149 @@
+"""Device fork/join plan execution: tape replay, batched time travel, and
+batched origin queries — validated against the M1 engine and the host dense
+executor (the reference's own differential pattern, test_conversion.rs)."""
+
+import numpy as np
+import pytest
+
+from diamond_types_tpu.core.span import UNDERWATER_START
+from diamond_types_tpu.listmerge.dense import INSERTED, NIY
+from diamond_types_tpu.text.op import INS
+from diamond_types_tpu.tpu.plan_kernels import (entry_frontier,
+                                                origin_query_jax,
+                                                snapshot_rows,
+                                                texts_at_versions)
+from tests.test_encode import build_random_oplog
+from tests.test_linearize import _fuzz_oplog
+
+
+def _doc_len_arrays(oplog, plan, tape):
+    """(len_ord, plen): per-slot char lengths in document order, underwater
+    clipped to the real base text (mirrors texts_at_versions)."""
+    base_text = oplog.checkout(plan.common).snapshot()
+    plen = len(base_text)
+    sid, slen = tape.sorted_ids, tape.sorted_lens
+    uw = sid >= UNDERWATER_START
+    uw_off = np.where(uw, sid - UNDERWATER_START, 0)
+    text_len = np.where(
+        uw, np.maximum(0, np.minimum(uw_off + slen, plen) - uw_off),
+        slen).astype(np.int64)
+    return text_len[tape.perm], plen
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_rows_give_correct_historical_texts(seed):
+    """Every snapshot row, materialized, must equal the M1 engine's
+    checkout at that entry's version frontier."""
+    ol = build_random_oplog(seed, steps=40)
+    plan, ex, tape, rows = snapshot_rows(ol, [])
+    if not plan.entries:
+        pytest.skip("linear history: no conflict zone")
+    texts = texts_at_versions(ol, range(len(plan.entries)))
+    for k in range(len(plan.entries)):
+        f = entry_frontier(ol.cg.graph, plan, k)
+        expected = ol.checkout(f).snapshot()
+        assert texts[k] == expected, f"entry {k} at {f}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_device_time_travel_cross_sync(seed):
+    ol = _fuzz_oplog(seed, steps=25, cross_sync=True)
+    plan, ex, tape, rows = snapshot_rows(ol, [])
+    ks = list(range(0, len(plan.entries), 3))
+    texts = texts_at_versions(ol, ks)
+    for i, k in enumerate(ks):
+        f = entry_frontier(ol.cg.graph, plan, k)
+        assert texts[i] == ol.checkout(f).snapshot()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_origin_queries_match_tracker(seed):
+    """For every entry whose first op is an insert and which has at most
+    one in-zone parent, the device origin query against the parent-version
+    row must reproduce the (origin_left, origin_right) pair the host
+    tracker extracted during the real merge. (Later ops of an entry fold in
+    intra-branch effects — that sequential threading stays on the host/C++
+    tier by design.)"""
+    import jax.numpy as jnp
+
+    ol = _fuzz_oplog(100 + seed, steps=25, cross_sync=True)
+    plan, ex, tape, rows = snapshot_rows(ol, [])
+    if not plan.entries:
+        pytest.skip("no conflict zone")
+    len_ord, _plen = _doc_len_arrays(ol, plan, tape)
+    ids_ord = tape.sorted_ids[tape.perm]
+
+    base_row_sorted = tape.is_base.astype(np.uint8)
+    checked = 0
+    for k, en in enumerate(plan.entries):
+        if len(en.parents) > 1:
+            continue
+        first = next(ol.ops.iter_range(en.span))
+        if first.kind != INS:
+            continue
+        row_sorted = rows[en.parents[0]] if en.parents else base_row_sorted
+        row_ord = row_sorted[tape.perm]
+        ol_j, ol_off, orr_j, orr_off = (
+            np.asarray(x) for x in origin_query_jax(
+                jnp.asarray(row_ord.astype(np.int32)),
+                jnp.asarray(len_ord.astype(np.int32)),
+                jnp.asarray(np.array([first.start], dtype=np.int32))))
+        got_ol = -1 if ol_j[0] < 0 else int(ids_ord[ol_j[0]] + ol_off[0])
+        got_orr = -1 if orr_j[0] < 0 else int(ids_ord[orr_j[0]] + orr_off[0])
+
+        slot = ex.slots[ex._ins_lookup(first.lv)]
+        assert slot.ids == first.lv
+        assert got_ol == slot.ol, (k, first.lv, got_ol, slot.ol)
+        assert got_orr == slot.orr, (k, first.lv, got_orr, slot.orr)
+        checked += 1
+    assert checked >= 3, "fuzz produced too few first-op inserts"
+
+
+def test_wide_fanin_origins_batched():
+    """The north-star shape: N replicas concurrently editing one base doc.
+    ALL their first-insert origins resolve in ONE device call against the
+    shared base row."""
+    import jax.numpy as jnp
+
+    from diamond_types_tpu.text.oplog import OpLog
+
+    ol = OpLog()
+    base_agent = ol.get_or_create_agent_id("base")
+    v = []
+    text = "abcdefghijklmnopqrstuvwxyz" * 4
+    lv = ol.add_insert_at(base_agent, v, 0, text)
+    base_v = [lv]
+    n_rep = 48
+    rng = np.random.RandomState(7)
+    pos = rng.randint(0, len(text) + 1, size=n_rep)
+    first_lvs = []
+    for i in range(n_rep):
+        ag = ol.get_or_create_agent_id(f"rep{i:03d}")
+        first_lvs.append(ol.add_insert_at(ag, base_v, int(pos[i]),
+                                          f"<{i}>"))
+
+    plan, ex, tape, rows = snapshot_rows(ol, [])
+    len_ord, _ = _doc_len_arrays(ol, plan, tape)
+    ids_ord = tape.sorted_ids[tape.perm]
+    row_ord = tape.is_base.astype(np.uint8)[tape.perm]
+
+    ol_j, ol_off, orr_j, orr_off = (
+        np.asarray(x) for x in origin_query_jax(
+            jnp.asarray(row_ord.astype(np.int32)),
+            jnp.asarray(len_ord.astype(np.int32)),
+            jnp.asarray(pos.astype(np.int32))))
+
+    for i in range(n_rep):
+        slot = ex.slots[ex._ins_lookup(first_lvs[i])]
+        got_ol = -1 if ol_j[i] < 0 else int(ids_ord[ol_j[i]] + ol_off[i])
+        got_orr = -1 if orr_j[i] < 0 else int(ids_ord[orr_j[i]] + orr_off[i])
+        assert got_ol == slot.ol and got_orr == slot.orr, i
+
+
+def test_tape_state_lattice_respected():
+    """Device rows only ever contain lattice values 0/1/2 and base slots
+    start Inserted in fresh rows."""
+    ol = build_random_oplog(3, steps=40)
+    plan, ex, tape, rows = snapshot_rows(ol, [])
+    assert rows.max() <= 2
+    assert set(np.unique(rows)) <= {NIY, INSERTED, 2}
