@@ -14,6 +14,23 @@ long-poll-free pull/push — each payload is a v1-format binary patch):
                             -> binary patch from the common version
   POST /doc/{id}/push       body: binary patch -> {"ok": true}
 
+Browser tier (the reference's "dumb client" OT mode — README.md:31-33;
+clients are positional, the server's CRDT does the merging; see
+web_assets.py for the pages):
+
+  GET  /                    -> index page
+  GET  /edit/{id}           -> collaborative editor (HTML/JS)
+  GET  /vis/{id}            -> causal-graph visualizer (HTML/JS)
+  GET  /doc/{id}/state      -> {"text": ..., "version": [[agent, seq]...]}
+  POST /doc/{id}/edit       body {"agent", "version", "ops": [{kind:"ins",
+                            pos, text} | {kind:"del", start, end}]}
+                            -> {"version": ...} (ops applied AT that
+                            version; concurrent edits merge via the CRDT)
+  POST /doc/{id}/changes    body {"version": ...} -> {"op": traversal,
+                            "version": ...} — OT catch-up since `version`
+  GET  /doc/{id}/graph      -> causal DAG runs JSON (visualizer data)
+  POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
+
 Run: python -m diamond_types_tpu.tools.server --port 8008 --data-dir docs/
 """
 
@@ -22,6 +39,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import threading
 import time
 import urllib.request
@@ -32,6 +50,10 @@ from ..causalgraph.summary import intersect_with_summary, summarize_versions
 from ..encoding.decode import decode_into, load_oplog
 from ..encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
 from ..text.oplog import OpLog
+
+# Doc ids are filenames (DocStore writes {data_dir}/{id}.dt) and are
+# interpolated into the served pages: restrict to a safe charset.
+_DOC_ID_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 
 
 class DocStore:
@@ -103,11 +125,24 @@ class SyncHandler(BaseHTTPRequestHandler):
 
     def _route(self):
         parts = self.path.strip("/").split("/")
-        if len(parts) >= 2 and parts[0] == "doc":
+        if len(parts) >= 2 and parts[0] == "doc" and _DOC_ID_RE.match(parts[1]):
             return parts[1], (parts[2] if len(parts) > 2 else "")
         return None, None
 
     def do_GET(self):
+        from .web_assets import EDITOR_HTML, INDEX_HTML, VIS_HTML
+
+        parts = self.path.strip("/").split("/")
+        if self.path == "/" or self.path == "":
+            return self._send(200, INDEX_HTML.encode("utf8"),
+                              "text/html; charset=utf-8")
+        if len(parts) == 2 and parts[0] in ("edit", "vis"):
+            if not _DOC_ID_RE.match(parts[1]):
+                return self._send(404, b"{}")
+            page = EDITOR_HTML if parts[0] == "edit" else VIS_HTML
+            return self._send(200, page.replace("__DOC__", parts[1])
+                              .encode("utf8"), "text/html; charset=utf-8")
+
         doc_id, action = self._route()
         if doc_id is None:
             return self._send(404, b"{}")
@@ -119,6 +154,23 @@ class SyncHandler(BaseHTTPRequestHandler):
         if action == "summary":
             return self._send(
                 200, json.dumps(summarize_versions(ol.cg)).encode("utf8"))
+        if action == "state":
+            with self.store.lock:
+                body = json.dumps({
+                    "text": ol.checkout_tip().snapshot(),
+                    "version": ol.cg.local_to_remote_frontier(ol.version)})
+            return self._send(200, body.encode("utf8"))
+        if action == "graph":
+            with self.store.lock:
+                g = ol.cg.graph
+                aa = ol.cg.agent_assignment
+                runs = []
+                for i in range(len(g.starts)):
+                    agent, _seq = aa.local_to_agent_version(g.starts[i])
+                    runs.append({"start": g.starts[i], "end": g.ends[i],
+                                 "parents": list(g.parents[i]),
+                                 "agent": aa.get_agent_name(agent)})
+            return self._send(200, json.dumps({"runs": runs}).encode("utf8"))
         return self._send(404, b"{}")
 
     def do_POST(self):
@@ -138,6 +190,44 @@ class SyncHandler(BaseHTTPRequestHandler):
             self.store.mark_dirty(doc_id)
             self.store.flush()
             return self._send(200, b'{"ok": true}')
+        if action == "edit":
+            req = json.loads(body)
+            with self.store.lock:
+                agent = ol.get_or_create_agent_id(req["agent"])
+                frontier = list(ol.cg.remote_to_local_frontier(
+                    req.get("version") or []))
+                for op in req["ops"]:
+                    if op["kind"] == "ins":
+                        lv = ol.add_insert_at(agent, frontier, op["pos"],
+                                              op["text"])
+                    else:
+                        lv = ol.add_delete_at(agent, frontier, op["start"],
+                                              op["end"], None)
+                    frontier = [lv]
+                out = ol.cg.local_to_remote_frontier(frontier)
+            self.store.mark_dirty(doc_id)
+            self.store.flush()
+            return self._send(200, json.dumps({"version": out})
+                              .encode("utf8"))
+        if action == "changes":
+            from ..text import ot
+            req = json.loads(body or b"{}")
+            with self.store.lock:
+                frontier = list(ol.cg.remote_to_local_frontier(
+                    req.get("version") or []))
+                trav = ot.xf_stream_to_traversal(
+                    ol.iter_xf_operations_from(frontier, ol.version))
+                out = {"op": trav,
+                       "version": ol.cg.local_to_remote_frontier(
+                           ol.cg.graph.version_union(frontier, ol.version))}
+            return self._send(200, json.dumps(out).encode("utf8"))
+        if action == "at":
+            req = json.loads(body)
+            with self.store.lock:
+                f = ol.cg.graph.find_dominators([int(req["lv"])])
+                text = ol.checkout(f).snapshot()
+            return self._send(200, json.dumps({"text": text})
+                              .encode("utf8"))
         return self._send(404, b"{}")
 
 

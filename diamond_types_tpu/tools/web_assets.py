@@ -1,0 +1,210 @@
+"""Static HTML/JS for the browser demo client and merge visualizer.
+
+Capability mirror of the reference's browser tier (reference:
+wiki/client/dt_doc.ts:40-171 — a live collaborative editor against the sync
+server; vis/src/App.svelte — the merge/DAG visualizer). The reference's
+client runs the CRDT itself via WASM; this client is the reference's OTHER
+documented integration mode — a plain positional ("dumb") client speaking
+operational transform (reference README.md:31-33: "interoperable with
+positional updates ... via operational transform"), so the browser needs no
+CRDT at all: it sends positional edits tagged with the version it saw and
+catches up by applying server-computed traversal ops (text/ot.py).
+
+Caveat (demo-scope): JS strings are UTF-16; traversal positions are unicode
+chars. Text outside the BMP would need the wchar conversion endpoints
+(core/unicount.py) — the reference wiki client has the same split.
+"""
+
+INDEX_HTML = """<!doctype html>
+<meta charset="utf-8"><title>diamond-types-tpu</title>
+<style>
+ body{font:15px system-ui;margin:3em auto;max-width:40em;color:#222}
+ input{font:inherit;padding:.3em}</style>
+<h1>diamond-types-tpu sync server</h1>
+<p>Open a document (creates it if missing):</p>
+<form onsubmit="go();return false">
+ <input id="d" placeholder="doc id" value="note">
+ <button>edit</button>
+ <button type=button onclick="vis()">visualize</button>
+</form>
+<script>
+ const f=()=>document.getElementById('d').value.trim()||'note';
+ function go(){location.href='/edit/'+encodeURIComponent(f())}
+ function vis(){location.href='/vis/'+encodeURIComponent(f())}
+</script>
+"""
+
+EDITOR_HTML = """<!doctype html>
+<meta charset="utf-8"><title>edit: __DOC__</title>
+<style>
+ body{font:15px system-ui;margin:2em auto;max-width:46em;color:#222}
+ textarea{width:100%;height:24em;font:14px/1.5 ui-monospace,monospace;
+          padding:1em;box-sizing:border-box;border:1px solid #bbb;
+          border-radius:6px}
+ #st{color:#777;font-size:13px;margin-top:.5em}
+ a{color:#06c}
+</style>
+<h2>__DOC__ <a href="/vis/__DOC__" style="font-size:14px">DAG</a></h2>
+<textarea id="t" spellcheck="false" disabled>loading…</textarea>
+<div id="st">connecting…</div>
+<script>
+const DOC = "__DOC__";
+const AGENT = "web-" + Math.random().toString(36).slice(2, 8);
+const ta = document.getElementById("t"), st = document.getElementById("st");
+let version = null, shadow = "", inflight = false, queue = [];
+
+const api = (path, body) => fetch(`/doc/${DOC}/${path}`, {
+  method: "POST", body: JSON.stringify(body)}).then(r => r.json());
+
+// Single-edit diff: common prefix/suffix between shadow and textarea.
+function diffOps(oldS, newS) {
+  if (oldS === newS) return [];
+  let p = 0, oe = oldS.length, ne = newS.length;
+  while (p < oe && p < ne && oldS[p] === newS[p]) p++;
+  while (oe > p && ne > p && oldS[oe - 1] === newS[ne - 1]) { oe--; ne--; }
+  const ops = [];
+  if (oe > p) ops.push({kind: "del", start: p, end: oe});
+  if (ne > p) ops.push({kind: "ins", pos: p, text: newS.slice(p, ne)});
+  return ops;
+}
+
+function applyTraversal(text, op, cursor) {
+  let pos = 0, out = "", cur = cursor;
+  for (const c of op) {
+    if (typeof c === "number") { out += text.slice(pos, pos + c); pos += c; }
+    else if (typeof c === "string") {
+      if (out.length <= cur) cur += c.length;
+      out += c;
+    } else {
+      if (out.length < cur) cur = Math.max(out.length, cur - c.d);
+      pos += c.d;
+    }
+  }
+  return [out + text.slice(pos), cur];
+}
+
+function onInput() {
+  const ops = diffOps(shadow, ta.value);
+  if (ops.length) { queue.push(...ops); shadow = ta.value; pump(); }
+}
+
+async function pump() {
+  if (inflight || !queue.length) return;
+  inflight = true;
+  const batch = queue.splice(0);
+  try {
+    const r = await api("edit", {agent: AGENT, version, ops: batch});
+    version = r.version;
+    st.textContent = `saved · version ${JSON.stringify(version)}`;
+  } catch (e) {
+    st.textContent = "edit failed (retrying): " + e;
+    queue.unshift(...batch);
+    inflight = false;
+    setTimeout(pump, 1500);   // back off instead of hammering the server
+    return;
+  }
+  inflight = false;
+  pump();
+}
+
+async function poll() {
+  if (!inflight && !queue.length) {
+    const v0 = version;
+    try {
+      const r = await api("changes", {version: v0});
+      // An edit raced the request: its response version superseded v0 and
+      // the traversal below would replay our own op. Drop this round.
+      if (!inflight && !queue.length && version === v0) {
+        if (r.op.length) {
+          const [text, cur] = applyTraversal(shadow, r.op,
+                                             ta.selectionStart);
+          shadow = text; ta.value = text;
+          ta.setSelectionRange(cur, cur);
+        }
+        version = r.version;
+        st.textContent = `synced · version ${JSON.stringify(version)}`;
+      }
+    } catch (e) { st.textContent = "sync lost: " + e; }
+  }
+  setTimeout(poll, 700);
+}
+
+(async () => {
+  const r = await fetch(`/doc/${DOC}/state`).then(r => r.json());
+  version = r.version; shadow = r.text;
+  ta.value = r.text; ta.disabled = false; ta.focus();
+  ta.addEventListener("input", onInput);
+  st.textContent = "connected as " + AGENT;
+  poll();
+})();
+</script>
+"""
+
+VIS_HTML = """<!doctype html>
+<meta charset="utf-8"><title>DAG: __DOC__</title>
+<style>
+ body{font:14px system-ui;margin:1.5em;color:#222}
+ #wrap{display:flex;gap:1.5em}
+ svg{border:1px solid #ccc;border-radius:6px;background:#fafafa}
+ #side{max-width:26em}
+ pre{background:#f4f4f4;padding:.8em;border-radius:6px;white-space:pre-wrap}
+ .run{cursor:pointer}
+ .run:hover rect{stroke:#06c;stroke-width:2}
+</style>
+<h2>causal graph: __DOC__ <a href="/edit/__DOC__"
+ style="font-size:14px">editor</a></h2>
+<div id="wrap">
+ <svg id="g" width="640" height="200"></svg>
+ <div id="side"><em>click a run to time-travel to that version</em>
+  <pre id="txt"></pre></div>
+</div>
+<script>
+const DOC = "__DOC__";
+const NS = "http://www.w3.org/2000/svg";
+fetch(`/doc/${DOC}/graph`).then(r => r.json()).then(g => {
+  const svg = document.getElementById("g");
+  const agents = [...new Set(g.runs.map(r => r.agent))];
+  const laneW = 150, rowH = 38;
+  svg.setAttribute("width", Math.max(640, agents.length * laneW + 40));
+  svg.setAttribute("height", g.runs.length * rowH + 50);
+  const ctr = {};
+  agents.forEach((a, i) => {
+    const t = document.createElementNS(NS, "text");
+    t.setAttribute("x", 20 + i * laneW); t.setAttribute("y", 22);
+    t.textContent = a; t.setAttribute("font-weight", "600");
+    svg.appendChild(t);
+  });
+  g.runs.forEach((r, i) => {
+    const x = 20 + agents.indexOf(r.agent) * laneW, y = 36 + i * rowH;
+    ctr[r.end - 1] = [x + 55, y + 11];
+    for (const p of r.parents) {
+      if (!(p in ctr)) continue;
+      const [px, py] = ctr[p];
+      const e = document.createElementNS(NS, "path");
+      e.setAttribute("d", `M${px},${py}C${px},${y - 8} ${x + 55},${py + 16}` +
+                          ` ${x + 55},${y}`);
+      e.setAttribute("fill", "none"); e.setAttribute("stroke", "#999");
+      svg.appendChild(e);
+    }
+    const grp = document.createElementNS(NS, "g");
+    grp.setAttribute("class", "run");
+    const b = document.createElementNS(NS, "rect");
+    b.setAttribute("x", x); b.setAttribute("y", y);
+    b.setAttribute("width", 110); b.setAttribute("height", 22);
+    b.setAttribute("rx", 5); b.setAttribute("fill", "#fff");
+    b.setAttribute("stroke", "#888");
+    const t = document.createElementNS(NS, "text");
+    t.setAttribute("x", x + 6); t.setAttribute("y", y + 15);
+    t.setAttribute("font-size", "12");
+    t.textContent = `[${r.start}..${r.end})`;
+    grp.appendChild(b); grp.appendChild(t);
+    grp.addEventListener("click", async () => {
+      const resp = await fetch(`/doc/${DOC}/at`, {
+        method: "POST", body: JSON.stringify({lv: r.end - 1})});
+      document.getElementById("txt").textContent = (await resp.json()).text;
+    });
+    svg.appendChild(grp);
+  });
+});
+</script>
+"""

@@ -41,6 +41,7 @@ from ..core.span import UNDERWATER_START
 from ..listmerge.dense import DenseExecutor
 from ..listmerge.plan2 import (APPLY, BEGIN, DROP, FORK, MAX, MergePlan2,
                                compile_plan2)
+from .merge_kernel import _pow2
 
 # Tape opcodes.
 T_WRITE = 0   # a=slot_lo, b=slot_hi (id-sorted ranks), c=state, d=row
@@ -133,10 +134,6 @@ def pack_plan_tape(plan: MergePlan2, ex: DenseExecutor,
 
 _tape_jit_cache = {}
 _materialize_jit_cache = {}
-
-
-def _pow2(x: int) -> int:
-    return 1 << max(1, int(x) - 1).bit_length()
 
 
 def execute_tape_jax(op, a, b, c, d, is_base, n_slots: int, n_idx: int,

@@ -37,3 +37,109 @@ def test_two_clients_collaborate(tmp_path):
         assert ol.checkout_tip().snapshot() == a.text()
     finally:
         httpd.shutdown()
+
+
+def _api(base, doc, action, body):
+    import json
+    import urllib.request
+    req = urllib.request.Request(f"{base}/doc/{doc}/{action}",
+                                 data=json.dumps(body).encode("utf8"))
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+class DumbClient:
+    """Python simulation of the browser editor's loop (web_assets.py):
+    positional edits at a remembered version + OT traversal catch-up.
+    No CRDT on the client at all."""
+
+    def __init__(self, base, doc, agent):
+        import json
+        import urllib.request
+        self.base, self.doc, self.agent = base, doc, agent
+        with urllib.request.urlopen(f"{base}/doc/{doc}/state") as r:
+            st = json.loads(r.read())
+        self.text, self.version = st["text"], st["version"]
+
+    def edit(self, ops):
+        # apply locally the way a textarea already shows the user's typing
+        for op in ops:
+            if op["kind"] == "ins":
+                p = op["pos"]
+                self.text = self.text[:p] + op["text"] + self.text[p:]
+            else:
+                self.text = self.text[:op["start"]] + self.text[op["end"]:]
+        r = _api(self.base, self.doc, "edit",
+                 {"agent": self.agent, "version": self.version, "ops": ops})
+        self.version = r["version"]
+
+    def sync(self):
+        from diamond_types_tpu.text import ot
+        r = _api(self.base, self.doc, "changes", {"version": self.version})
+        self.text = ot.apply(self.text, r["op"])
+        self.version = r["version"]
+
+
+def test_browser_dumb_clients_converge(tmp_path):
+    """Two positional browser clients + one CRDT client, concurrent edits,
+    everyone converges (reference: wiki demo end-user edit loop)."""
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        w1 = DumbClient(base, "page", "web-one")
+        w1.edit([{"kind": "ins", "pos": 0, "text": "The quick brown fox"}])
+
+        w2 = DumbClient(base, "page", "web-two")
+        w2.sync()
+        assert w2.text == "The quick brown fox"
+
+        # Concurrent: w1 edits the head, w2 the tail, crdt client the middle.
+        c = SyncClient(base, "page", "carol")
+        c.pull()
+        w1.edit([{"kind": "ins", "pos": 0, "text": ">> "}])
+        w2.edit([{"kind": "del", "start": 10, "end": 16},
+                 {"kind": "ins", "pos": 10, "text": "red"}])
+        c.insert(4, "very ")
+        c.sync()
+        for cl in (w1, w2):
+            cl.sync()
+        c.sync()
+        w1.sync()
+        assert w1.text == w2.text == c.text()
+        assert w1.text.startswith(">> ")
+        assert "red" in w1.text and "very" in w1.text
+    finally:
+        httpd.shutdown()
+
+
+def test_browser_pages_and_graph_endpoints(tmp_path):
+    import json
+    import urllib.request
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        w = DumbClient(base, "g", "web")
+        w.edit([{"kind": "ins", "pos": 0, "text": "hello"}])
+        w.edit([{"kind": "ins", "pos": 5, "text": " world"}])
+
+        for page in ("/", "/edit/g", "/vis/g"):
+            with urllib.request.urlopen(base + page) as r:
+                html = r.read().decode("utf8")
+            assert "<title>" in html or "<h1>" in html
+
+        with urllib.request.urlopen(base + "/doc/g/graph") as r:
+            g = json.loads(r.read())
+        assert g["runs"] and g["runs"][0]["agent"] == "web"
+        last = g["runs"][-1]["end"] - 1
+        at = _api(base, "g", "at", {"lv": last})
+        assert at["text"] == "hello world"
+        at0 = _api(base, "g", "at", {"lv": 4})
+        assert at0["text"] == "hello"
+    finally:
+        httpd.shutdown()
