@@ -148,12 +148,14 @@ class SyncHandler(BaseHTTPRequestHandler):
             return self._send(404, b"{}")
         ol = self.store.get(doc_id)
         if action == "":
-            text = ol.checkout_tip().snapshot()
+            with self.store.lock:
+                text = ol.checkout_tip().snapshot()
             return self._send(200, text.encode("utf8"),
                               "text/plain; charset=utf-8")
         if action == "summary":
-            return self._send(
-                200, json.dumps(summarize_versions(ol.cg)).encode("utf8"))
+            with self.store.lock:
+                body = json.dumps(summarize_versions(ol.cg)).encode("utf8")
+            return self._send(200, body)
         if action == "state":
             with self.store.lock:
                 body = json.dumps({
@@ -182,20 +184,38 @@ class SyncHandler(BaseHTTPRequestHandler):
         ol = self.store.get(doc_id)
         if action == "pull":
             summary = json.loads(body or b"{}")
-            common, _rem = intersect_with_summary(ol.cg, summary)
-            patch = encode_oplog(ol, ENCODE_PATCH, from_version=common)
+            with self.store.lock:
+                common, _rem = intersect_with_summary(ol.cg, summary)
+                patch = encode_oplog(ol, ENCODE_PATCH, from_version=common)
             return self._send(200, patch, "application/octet-stream")
         if action == "push":
-            decode_into(ol, body)
+            with self.store.lock:
+                decode_into(ol, body)
             self.store.mark_dirty(doc_id)
             self.store.flush()
             return self._send(200, b'{"ok": true}')
         if action == "edit":
             req = json.loads(body)
             with self.store.lock:
-                agent = ol.get_or_create_agent_id(req["agent"])
                 frontier = list(ol.cg.remote_to_local_frontier(
                     req.get("version") or []))
+                # Validate the WHOLE batch against the doc length at the
+                # client's version before touching the oplog: a rejected op
+                # must not leave earlier batch ops half-applied.
+                blen = len(ol.checkout(frontier))
+                for op in req["ops"]:
+                    if op["kind"] == "ins":
+                        if not (isinstance(op.get("text"), str) and op["text"]
+                                and 0 <= int(op["pos"]) <= blen):
+                            return self._send(400, b'{"error": "bad op"}')
+                        blen += len(op["text"])
+                    elif op["kind"] == "del":
+                        if not 0 <= int(op["start"]) < int(op["end"]) <= blen:
+                            return self._send(400, b'{"error": "bad op"}')
+                        blen -= int(op["end"]) - int(op["start"])
+                    else:
+                        return self._send(400, b'{"error": "bad op"}')
+                agent = ol.get_or_create_agent_id(req["agent"])
                 for op in req["ops"]:
                     if op["kind"] == "ins":
                         lv = ol.add_insert_at(agent, frontier, op["pos"],

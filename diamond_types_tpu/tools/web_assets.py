@@ -174,12 +174,16 @@ fetch(`/doc/${DOC}/graph`).then(r => r.json()).then(g => {
     t.textContent = a; t.setAttribute("font-weight", "600");
     svg.appendChild(t);
   });
+  // A parent LV can point mid-run (editing at a stale version): resolve
+  // it to the run containing it, not just run ends.
+  const runOf = p => g.runs.findIndex(r => r.start <= p && p < r.end);
   g.runs.forEach((r, i) => {
     const x = 20 + agents.indexOf(r.agent) * laneW, y = 36 + i * rowH;
-    ctr[r.end - 1] = [x + 55, y + 11];
+    ctr[i] = [x + 55, y + 11];
     for (const p of r.parents) {
-      if (!(p in ctr)) continue;
-      const [px, py] = ctr[p];
+      const pi = runOf(p);
+      if (!(pi in ctr)) continue;
+      const [px, py] = ctr[pi];
       const e = document.createElementNS(NS, "path");
       e.setAttribute("d", `M${px},${py}C${px},${y - 8} ${x + 55},${py + 16}` +
                           ` ${x + 55},${y}`);

@@ -143,3 +143,41 @@ def test_browser_pages_and_graph_endpoints(tmp_path):
         assert at0["text"] == "hello"
     finally:
         httpd.shutdown()
+
+
+def test_edit_endpoint_rejects_bad_ops(tmp_path):
+    import json
+    import urllib.error
+    import urllib.request
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        w = DumbClient(base, "v", "web")
+        w.edit([{"kind": "ins", "pos": 0, "text": "hello"}])
+        for bad in ([{"kind": "ins", "pos": 0, "text": ""}],       # empty
+                    [{"kind": "ins", "pos": 99, "text": "x"}],     # range
+                    [{"kind": "del", "start": 2, "end": 2}],       # empty
+                    [{"kind": "del", "start": 0, "end": 99}],      # range
+                    [{"kind": "nop"}]):                            # kind
+            try:
+                _api(base, "v", "edit",
+                     {"agent": "web", "version": w.version, "ops": bad})
+                raise AssertionError(f"accepted bad op {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        # a batch failing validation must not half-apply: doc unchanged
+        try:
+            _api(base, "v", "edit", {"agent": "web", "version": w.version,
+                 "ops": [{"kind": "ins", "pos": 0, "text": "A"},
+                         {"kind": "del", "start": 50, "end": 60}]})
+            raise AssertionError("accepted half-bad batch")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        import urllib.request as u
+        with u.urlopen(f"{base}/doc/v") as r:
+            assert r.read().decode() == "hello"
+    finally:
+        httpd.shutdown()
