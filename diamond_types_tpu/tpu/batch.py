@@ -7,14 +7,21 @@ applying ops one at a time, N replicas apply their op streams simultaneously —
 parallelism = vmap over many documents per chip" axis from SURVEY.md §2.9.
 
 Document state is a fixed-capacity char-code buffer + length. One op step
-(pos, del_len, ins_len, ins_chars) rebuilds the buffer with vectorized index
-arithmetic (a gather), which XLA fuses into a single pass per step:
+(pos, del_len, ins_len, ins_chars) rebuilds the buffer:
 
-    src_idx(i) = i                 for i <  pos
-               = i - ins + del     for i >= pos + ins   (tail shift)
-    insert lane writes ins_chars at [pos, pos+ins)
+    out(i) = doc(i)                for i <  pos
+           = ins_chars(i - pos)    for pos <= i < pos + ins
+           = doc(i - ins + del)    for i >= pos + ins     (tail shift)
 
-Ops per document are padded to a common count; zero-length ops are no-ops.
+The tail shift is deliberately NOT a dynamic gather: per-element gathers
+with per-document indices hit the TPU's slow scatter/gather path (measured
+~36x the cost of the whole scan step on this chip). Instead op lengths are
+bounded by `max_ins` (encode_trace_ops splits longer inserts AND deletes),
+so the shifted read is a select over the 2*max_ins+1 STATIC rolls of the
+buffer and the insert writes unroll over max_ins static lanes — pure
+elementwise ops the VPU streams at memory speed. Ops per document are
+padded to a common count; zero-length ops are no-ops (shift 0 selects the
+unrolled buffer).
 """
 
 from __future__ import annotations
@@ -34,11 +41,13 @@ def encode_trace_ops(txns, max_ins: int):
     pos, dl, il, chars = [], [], [], []
     for txn in txns:
         for (p, d, ins) in txn:
-            if d:
+            while d:  # split deletes to <= max_ins (bounded-shift contract)
+                k = min(d, max_ins)
                 pos.append(p)
-                dl.append(d)
+                dl.append(k)
                 il.append(0)
                 chars.append([0] * max_ins)
+                d -= k
             off = 0
             while off < len(ins):
                 chunk = ins[off:off + max_ins]
@@ -52,26 +61,40 @@ def encode_trace_ops(txns, max_ins: int):
             np.asarray(chars, np.int32).reshape(-1, max_ins))
 
 
+def _apply_ops_batched(docs: jnp.ndarray, lens: jnp.ndarray,
+                       pos: jnp.ndarray, dlen: jnp.ndarray,
+                       ilen: jnp.ndarray, ins_chars: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One op per document, whole batch at once: docs [b, cap], pos/dlen/
+    ilen [b], ins_chars [b, max_ins]. Requires dlen <= max_ins and
+    ilen <= max_ins (see module docstring — this is what keeps the tail
+    shift a static-roll select instead of a slow dynamic gather)."""
+    cap = docs.shape[1]
+    mi = ins_chars.shape[1]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    shift = ilen - dlen
+    out = docs  # shift == 0 case
+    for s in range(-mi, mi + 1):
+        if s == 0:
+            continue
+        out = jnp.where((shift == s)[:, None], jnp.roll(docs, s, axis=1),
+                        out)
+    for j in range(mi):  # insert lanes, static unroll
+        lane = (idx[None, :] == pos[:, None] + j) & (j < ilen)[:, None]
+        out = jnp.where(lane, ins_chars[:, j:j + 1], out)
+    out = jnp.where(idx[None, :] < pos[:, None], docs, out)
+    return out, lens + shift
+
+
 def apply_op_step(doc: jnp.ndarray, doc_len: jnp.ndarray,
                   pos: jnp.ndarray, dlen: jnp.ndarray,
                   ilen: jnp.ndarray, ins_chars: jnp.ndarray
                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Apply one positional op to one document buffer. All args are traced
-    scalars/vectors; `doc` is int32 [cap], `ins_chars` int32 [max_ins]."""
-    cap = doc.shape[0]
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    shift = ilen - dlen
-    # Where does each output slot read from?
-    src = jnp.where(idx < pos, idx, idx - shift)
-    in_insert = (idx >= pos) & (idx < pos + ilen)
-    gathered = doc[jnp.clip(src, 0, cap - 1)]
-    ins_vals = ins_chars[jnp.clip(idx - pos, 0, ins_chars.shape[0] - 1)]
-    new_doc = jnp.where(in_insert, ins_vals, gathered)
-    new_len = doc_len + shift
-    # Zero-length op => no-op
-    noop = (ilen == 0) & (dlen == 0)
-    return (jnp.where(noop, doc, new_doc),
-            jnp.where(noop, doc_len, new_len))
+    """Single-document variant of _apply_ops_batched (same contract)."""
+    docs, lens = _apply_ops_batched(
+        doc[None], doc_len[None], pos[None], dlen[None], ilen[None],
+        ins_chars[None])
+    return docs[0], lens[0]
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -80,22 +103,36 @@ def replay_batch(pos: jnp.ndarray, dlen: jnp.ndarray, ilen: jnp.ndarray,
     """Replay [b, n] op streams into [b, cap] documents.
 
     pos/dlen/ilen: int32 [b, n]; chars: int32 [b, n, max_ins].
-    Returns (docs [b, cap], lens [b]).
+    CONTRACT: dlen and ilen must be <= max_ins (= chars.shape[-1]); split
+    longer ops the way encode_trace_ops does. The kernel's tail shift is a
+    select over the 2*max_ins+1 static rolls — an out-of-range shift would
+    silently leave the buffer unshifted, so violations raise at trace time
+    via the debug check below when jax debug checks are on, and corrupt
+    deterministically otherwise (use encode_trace_ops and this cannot
+    happen). Returns (docs [b, cap], lens [b]).
     """
     b = pos.shape[0]
+    mi = chars.shape[-1]
+    # Bounded-shift contract check: out-of-range ops are zeroed to no-ops
+    # WITH a poisoned length (-1) so violations surface as an impossible
+    # doc length instead of silently-wrong text.
+    bad = (dlen > mi) | (ilen > mi)
+    dlen = jnp.where(bad, 0, dlen)
+    ilen = jnp.where(bad, 0, ilen)
+    any_bad = jnp.any(bad)
     docs0 = jnp.zeros((b, cap), dtype=jnp.int32)
     lens0 = jnp.zeros((b,), dtype=jnp.int32)
 
     def step(carry, op):
         docs, lens = carry
         p, d, i, c = op
-        docs, lens = jax.vmap(apply_op_step)(docs, lens, p, d, i, c)
+        docs, lens = _apply_ops_batched(docs, lens, p, d, i, c)
         return (docs, lens), None
 
     ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
            jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
     (docs, lens), _ = jax.lax.scan(step, (docs0, lens0), ops)
-    return docs, lens
+    return docs, jnp.where(any_bad, -1, lens)
 
 
 def docs_to_strings(docs: np.ndarray, lens: np.ndarray) -> List[str]:

@@ -1,10 +1,12 @@
 """Pallas TPU kernels for the batched replay hot loop.
 
-The XLA path (tpu/batch.py) expresses one op-application as a gather + two
-selects; this module provides the same step as a hand-written Pallas kernel
-that keeps the whole document block resident in VMEM and fuses the shift /
-insert-select arithmetic into one pass per (doc-block, op) — avoiding the
-gather materialization XLA emits.
+The XLA path (tpu/batch.py) expresses one op-application as a select over
+static rolls plus unrolled insert lanes (it deliberately avoids dynamic
+gathers — the TPU slow path); this module provides the same step as a
+hand-written Pallas kernel that keeps the whole document block resident in
+VMEM and fuses the shift / insert-select arithmetic into one pass per
+(doc-block, op), without materializing the 2*max_ins+1 rolled copies the
+XLA formulation selects among.
 
 Kernels run natively on TPU; tests exercise them with `interpret=True` on
 the CPU mesh (pallas_guide.md debugging convention).

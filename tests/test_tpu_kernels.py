@@ -191,3 +191,35 @@ def test_pallas_replay_matches_xla_path():
     docs, lens = replay_batch_pallas(*args, cap=64, interpret=True)
     assert np.array_equal(np.asarray(docs), np.asarray(ref_docs))
     assert np.array_equal(np.asarray(lens), np.asarray(ref_lens))
+
+
+def test_replay_long_deletes_split_to_bound():
+    """Deletes longer than max_ins exercise encode_trace_ops' split loop
+    and the shift == -max_ins extreme of the static-roll select."""
+    from diamond_types_tpu.text.trace import TestData, replay_direct
+    txns = [[(0, 0, "hello there world")], [(5, 9, "")], [(0, 0, ">>")],
+            [(2, 7, "")], [(0, 0, "ab")]]
+    data = TestData("", "", txns)
+    expected = replay_direct(data)
+
+    for max_ins in (2, 4):
+        pos, dl, il, chars = encode_trace_ops(txns, max_ins=max_ins)
+        assert dl.max() <= max_ins and il.max() <= max_ins
+        docs, lens = replay_batch(
+            jnp.asarray(pos[None]), jnp.asarray(dl[None]),
+            jnp.asarray(il[None]), jnp.asarray(chars[None]), cap=32)
+        out = docs_to_strings(np.asarray(docs), np.asarray(lens))
+        assert out[0] == expected, max_ins
+
+
+def test_replay_out_of_contract_ops_poison_length():
+    """Ops violating the dlen/ilen <= max_ins contract must not silently
+    produce wrong text: the length comes back -1."""
+    pos = np.zeros((1, 2), np.int32)
+    il = np.asarray([[4, 0]], np.int32)
+    dl = np.asarray([[0, 9]], np.int32)   # out of contract (max_ins = 4)
+    chars = np.zeros((1, 2, 4), np.int32)
+    chars[0, 0] = [104, 105, 33, 33]
+    _docs, lens = replay_batch(jnp.asarray(pos), jnp.asarray(dl),
+                               jnp.asarray(il), jnp.asarray(chars), cap=16)
+    assert int(np.asarray(lens)[0]) == -1
