@@ -371,16 +371,33 @@ def materialize_jax(perm, vis_len, arena_off, arena, cap: int):
     perm [n]: document-order permutation; vis_len [n]: visible char count
     of each run (0 for deleted/NIY/padding); arena_off [n]: first char of
     the run's content in `arena` (int32 char codes); cap: static output
-    size. Returns (text [cap] int32, total_len)."""
+    size. Returns (text [cap] int32, total_len).
+
+    Run expansion avoids per-output-char searchsorted + double gathers
+    (the TPU gather slow path): each live run parks its start position and
+    its affine src base (`arena start - doc start`) AT its start slot; a
+    plain cummax fills the monotone starts forward, leaving one gather for
+    the base and one for the actual text."""
     import jax.numpy as jnp
+    from jax import lax
 
     vl = vis_len[perm]
     cum = jnp.cumsum(vl)
     total = cum[-1] if vl.shape[0] else jnp.int64(0)
     starts = cum - vl
-    j = jnp.arange(cap)
-    r = jnp.searchsorted(cum, j, side="right")
-    rc = jnp.clip(r, 0, vl.shape[0] - 1)
-    src = arena_off[perm][rc] + (j - starts[rc])
+    base = arena_off[perm] - starts          # src[j] = base[run(j)] + j
+    cs = jnp.clip(starts, 0, cap - 1)
+    # Runs starting at/after cap can never contribute an output char; keep
+    # them out of the scatter or they'd collide into slot cap-1 and corrupt
+    # a truncated (cap < total) materialization.
+    live = (vl > 0) & (starts < cap)
+    S = jnp.zeros(cap, jnp.int32).at[cs].max(
+        jnp.where(live, starts, 0).astype(jnp.int32))
+    S = lax.associative_scan(jnp.maximum, S)
+    BIAS = jnp.int32(1) << 30                # keeps parked bases >= 0
+    parked = jnp.zeros(cap, jnp.int32).at[cs].max(
+        jnp.where(live, base + BIAS, 0).astype(jnp.int32))
+    j = jnp.arange(cap, dtype=jnp.int32)
+    src = parked[S] - BIAS + j
     text = arena[jnp.clip(src, 0, arena.shape[0] - 1)]
     return jnp.where(j < total, text, 0), total

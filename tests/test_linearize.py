@@ -265,3 +265,43 @@ def test_device_checkout_linear_doc():
 def test_device_checkout_empty_doc():
     empty = ListCRDT()
     assert checkout_device(empty.oplog) == ""
+
+
+def test_materialize_matches_searchsorted_reference_incl_truncation():
+    """The scatter+cummax run expansion must match the straightforward
+    searchsorted formulation bit-for-bit, including cap < total (truncated
+    materialization) and dead/empty runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from diamond_types_tpu.tpu.linearize import materialize_jax
+
+    def reference(perm, vis_len, arena_off, arena, cap):
+        vl = vis_len[perm]
+        cum = jnp.cumsum(vl)
+        total = cum[-1]
+        starts = cum - vl
+        j = jnp.arange(cap)
+        r = jnp.searchsorted(cum, j, side="right")
+        rc = jnp.clip(r, 0, vl.shape[0] - 1)
+        src = arena_off[perm][rc] + (j - starts[rc])
+        text = arena[jnp.clip(src, 0, arena.shape[0] - 1)]
+        return jnp.where(j < total, text, 0), total
+
+    n, caps = 32, (16, 64, 160)
+    new_j = {c: jax.jit(lambda p, v, a, ar, c=c:
+                        materialize_jax(p, v, a, ar, cap=c)) for c in caps}
+    ref_j = {c: jax.jit(lambda p, v, a, ar, c=c:
+                        reference(p, v, a, ar, c)) for c in caps}
+    rng = np.random.RandomState(1)
+    for _trial in range(60):
+        perm = rng.permutation(n).astype(np.int32)
+        vl = (rng.randint(0, 6, n) * (rng.random(n) < 0.7)).astype(np.int32)
+        ao = rng.randint(0, 500, n).astype(np.int32)
+        arena = rng.randint(1, 1000, 600).astype(np.int32)
+        args = tuple(jnp.asarray(x) for x in (perm, vl, ao, arena))
+        for cap in caps:
+            a = new_j[cap](*args)
+            b = ref_j[cap](*args)
+            assert int(a[1]) == int(b[1])
+            assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
