@@ -74,6 +74,14 @@ def _load():
         np.ctypeslib.ndpointer(np.int64, flags="C"),
         np.ctypeslib.ndpointer(np.uint8, flags="C")]
     lib.dt_dump_tracker.restype = ct.c_int64
+    lib.dt_dump_del_rows.argtypes = [
+        ct.c_void_p, ct.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C")]
+    lib.dt_dump_del_rows.restype = ct.c_int64
     lib.dt_get_zone_common.argtypes = [
         ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
     lib.dt_get_zone_common.restype = ct.c_int64
@@ -219,6 +227,25 @@ class NativeContext:
             return (ids[keep], ln[keep], ol[keep], orr[keep], st[keep],
                     ev[keep])
         return (ids, ln, ol, orr, st, ev)
+
+    def dump_del_rows(self):
+        """Delete-target rows of the last transform's tracker, sorted by
+        op LV: (lv0, lv1, t0, t1, fwd) arrays — op lv0+k deletes item
+        t0+k (fwd) or t1-1-k (reversed). Targets are intrinsic to each
+        delete op, so the rows are schedule-independent."""
+        lib = self._lib
+        z = np.zeros(0, dtype=np.int64)
+        zu = np.zeros(0, dtype=np.uint8)
+        n = lib.dt_dump_del_rows(self._ptr, 0, z, z, z, z, zu)
+        lv0 = np.empty(n, dtype=np.int64)
+        lv1 = np.empty(n, dtype=np.int64)
+        t0 = np.empty(n, dtype=np.int64)
+        t1 = np.empty(n, dtype=np.int64)
+        fwd = np.empty(n, dtype=np.uint8)
+        if n:
+            lib.dt_dump_del_rows(self._ptr, n, lv0, lv1, t0, t1, fwd)
+        o = np.argsort(lv0, kind="stable")
+        return lv0[o], lv1[o], t0[o], t1[o], fwd[o]
 
     def merge_to_string(self, init: str, from_frontier: Sequence[int],
                         merge_frontier: Sequence[int]):

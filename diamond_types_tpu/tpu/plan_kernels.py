@@ -68,20 +68,121 @@ class PackedTape:
     snap_entries: List[int]   # entry index per snapshot slot
 
 
-def pack_plan_tape(plan: MergePlan2, ex: DenseExecutor,
-                   snapshot_entries: Sequence[int]) -> PackedTape:
-    """Flatten a fork/join plan + the executor's write journal into a device
-    step tape. `ex` must have been run with journal=True."""
+@dataclass
+class TapeSource:
+    """Slot table + write journal a tape can be packed from. Two builders:
+    `source_from_executor` (the Python dense executor's own tables) and
+    `source_native` (C++ tracker dump + delete-target rows — no Python
+    execution of the zone at all)."""
+    ids: np.ndarray       # [n_slots] int64 item-id range starts
+    lens: np.ndarray      # [n_slots] int64
+    is_base: np.ndarray   # [n_slots] uint8 (pre-zone / underwater slots)
+    order: np.ndarray     # [n_slots] doc-order permutation into the above
+    n_idx: int
+    journal: list         # per-APPLY list of (id_lo, id_hi, state) writes
+
+
+def source_from_executor(ex: DenseExecutor) -> TapeSource:
     assert ex.journal is not None, "executor must be run with journal=True"
+    n = len(ex.slots)
+    return TapeSource(
+        ids=np.array([s.ids for s in ex.slots], dtype=np.int64),
+        lens=np.array([len(s) for s in ex.slots], dtype=np.int64),
+        is_base=np.asarray(ex.is_base[:n], dtype=np.uint8),
+        order=np.asarray(ex.order, dtype=np.int64),
+        n_idx=ex.n_idx, journal=ex.journal)
+
+
+def source_native(oplog, plan: MergePlan2, from_frontier,
+                  merge_frontier) -> TapeSource:
+    """Build the tape source from the C++ engine: one native transform
+    gives the final item table (document order) and the delete-target rows;
+    the journal is derived from the op table (inserts) and those rows
+    (deletes) — delete targets are intrinsic to each op, so the M1-walk-
+    recorded rows are valid for the fork/join schedule too. The native
+    items are RLE-merged, so they are split at every journal-write
+    boundary to restore the alignment pack_plan_tape asserts."""
+    from ..listmerge.dense import DELETED, INSERTED
+    from ..native.core import get_native_ctx
+    from ..text.op import INS
+
+    ctx = get_native_ctx(oplog)
+    ctx.transform([int(x) for x in from_frontier],
+                  [int(x) for x in merge_frontier])
+    common = ctx.zone_common()
+    assert sorted(common) == sorted(plan.common), \
+        "native transform and plan disagree on the conflict zone"
+    ids, lens, *_rest = ctx.dump_tracker(keep_underwater=True)
+    lv0, lv1, t0, t1, fwd = ctx.dump_del_rows()
+    ctx.release_tracker()
+
+    journal = []
+    bounds = set()
+    for en in plan.entries:
+        writes = []
+        for piece in oplog.ops.iter_range(en.span):
+            if piece.kind == INS:
+                writes.append((piece.lv, piece.lv + len(piece), INSERTED))
+            else:
+                a, b = piece.lv, piece.lv + len(piece)
+                j = int(np.searchsorted(lv0, a, side="right")) - 1
+                while a < b:
+                    assert 0 <= j < len(lv0) and lv0[j] <= a < lv1[j], \
+                        "delete op not covered by native del rows"
+                    e = min(b, int(lv1[j]))
+                    if fwd[j]:
+                        tr = (int(t0[j]) + (a - int(lv0[j])),
+                              int(t0[j]) + (e - int(lv0[j])))
+                    else:
+                        tr = (int(t1[j]) - (e - int(lv0[j])),
+                              int(t1[j]) - (a - int(lv0[j])))
+                    writes.append((tr[0], tr[1], DELETED))
+                    a = e
+                    j += 1
+        for (lo, hi, _s) in writes:
+            bounds.add(lo)
+            bounds.add(hi)
+        journal.append(writes)
+
+    # Split the RLE-merged native items at write boundaries (doc order is
+    # preserved: splits are adjacent).
+    bs = np.array(sorted(bounds), dtype=np.int64)
+    out_ids, out_lens = [], []
+    for i in range(len(ids)):
+        s, e = int(ids[i]), int(ids[i] + lens[i])
+        lo = int(np.searchsorted(bs, s, side="right"))
+        hi = int(np.searchsorted(bs, e, side="left"))
+        prev = s
+        for cut in bs[lo:hi]:
+            out_ids.append(prev)
+            out_lens.append(int(cut) - prev)
+            prev = int(cut)
+        out_ids.append(prev)
+        out_lens.append(e - prev)
+    oids = np.array(out_ids, dtype=np.int64)
+    olens = np.array(out_lens, dtype=np.int64)
+    return TapeSource(
+        ids=oids, lens=olens,
+        is_base=(oids >= UNDERWATER_START).astype(np.uint8),
+        order=np.arange(len(oids), dtype=np.int64),
+        n_idx=max(1, plan.indexes_used), journal=journal)
+
+
+def pack_plan_tape(plan: MergePlan2, src, snapshot_entries: Sequence[int]
+                   ) -> PackedTape:
+    """Flatten a fork/join plan + a write journal into a device step tape.
+    `src` is a TapeSource or a journal=True DenseExecutor."""
+    if isinstance(src, DenseExecutor):
+        src = source_from_executor(src)
     for e in snapshot_entries:
         if not 0 <= int(e) < len(plan.entries):
             raise IndexError(
                 f"snapshot entry {e} out of range: plan has "
                 f"{len(plan.entries)} conflict entries (a pure fast-forward "
                 f"history has none — use oplog.checkout for those versions)")
-    n_slots = len(ex.slots)
-    ids = np.array([s.ids for s in ex.slots], dtype=np.int64)
-    lens = np.array([len(s) for s in ex.slots], dtype=np.int64)
+    n_slots = len(src.ids)
+    ids = src.ids
+    lens = src.lens
     rank_order = np.argsort(ids, kind="stable")
     sorted_ids = ids[rank_order]
     sorted_lens = lens[rank_order]
@@ -114,19 +215,19 @@ def pack_plan_tape(plan: MergePlan2, ex: DenseExecutor,
         elif kind == DROP:
             pass
         elif kind == APPLY:
-            for (lo, hi, state) in ex.journal[apply_i]:
+            for (lo, hi, state) in src.journal[apply_i]:
                 ra, rb = rank_range(lo, hi)
                 emit(T_WRITE, ra, rb, state, act[2])
             if act[1] in want:
                 emit(T_SNAP, act[2], want[act[1]])
             apply_i += 1
 
-    is_base = np.asarray(ex.is_base[:n_slots], dtype=np.uint8)[rank_order]
-    perm = rank_of[np.asarray(ex.order, dtype=np.int64)].astype(np.int32)
+    is_base = np.asarray(src.is_base, dtype=np.uint8)[rank_order]
+    perm = rank_of[np.asarray(src.order, dtype=np.int64)].astype(np.int32)
     return PackedTape(
         op=np.array(op, dtype=np.int32), a=np.array(aa, dtype=np.int32),
         b=np.array(bb, dtype=np.int32), c=np.array(cc, dtype=np.int32),
-        d=np.array(dd, dtype=np.int32), n_slots=n_slots, n_idx=ex.n_idx,
+        d=np.array(dd, dtype=np.int32), n_slots=n_slots, n_idx=src.n_idx,
         n_snaps=len(snapshot_entries), is_base=is_base,
         sorted_ids=sorted_ids, sorted_lens=sorted_lens, perm=perm,
         snap_entries=[int(e) for e in snapshot_entries])
@@ -222,17 +323,29 @@ def _execute_tape(op, a, b, c, d, is_base, n_slots: int, n_idx: int,
 
 def snapshot_rows(oplog, from_frontier: Sequence[int],
                   merge_frontier: Optional[Sequence[int]] = None,
-                  entries: Optional[Sequence[int]] = None):
-    """Compile + host-execute (for the journal) + device-replay a merge,
-    returning (plan, executor, tape, rows) where rows[i] is the device-
-    computed state row at snapshot entry i's version."""
+                  entries: Optional[Sequence[int]] = None,
+                  source: str = "python"):
+    """Compile + journal (host) + device-replay a merge, returning
+    (plan, source, tape, rows) where rows[i] is the device-computed state
+    row at snapshot entry i's version.
+
+    source="python" runs the dense executor for the journal (also yields
+    slot origins — the origin-query tests use them); source="native" gets
+    the journal from one C++ transform + the delete-target rows — no
+    Python zone execution, fast enough for the shipped corpora."""
     merge = list(oplog.version) if merge_frontier is None \
         else list(merge_frontier)
     plan = compile_plan2(oplog.cg.graph, list(from_frontier), merge)
-    ex = DenseExecutor(plan, oplog.cg.agent_assignment, oplog.ops,
-                       journal=True)
-    for _ in ex.run():
-        pass
+    if source == "native":
+        ex = source_native(oplog, plan, list(from_frontier), merge)
+    elif source == "python":
+        ex = DenseExecutor(plan, oplog.cg.agent_assignment, oplog.ops,
+                           journal=True)
+        for _ in ex.run():
+            pass
+    else:
+        raise ValueError(f"unknown source {source!r}: use 'python' or "
+                         f"'native'")
     if entries is None:
         entries = range(len(plan.entries))
     tape = pack_plan_tape(plan, ex, list(entries))
@@ -261,7 +374,8 @@ def entry_frontier(graph, plan: MergePlan2, k: int) -> List[int]:
 # ---- batched time travel -------------------------------------------------
 
 def texts_at_versions(oplog, entries: Sequence[int],
-                      from_frontier: Sequence[int] = ()) -> List[str]:
+                      from_frontier: Sequence[int] = (),
+                      source: str = "python") -> List[str]:
     """Materialize the document at many historical versions (one per
     snapshot entry) in a single vmapped device call.
 
@@ -278,7 +392,7 @@ def texts_at_versions(oplog, entries: Sequence[int],
     from .merge_kernel import _arena_offsets
 
     plan, ex, tape, rows = snapshot_rows(oplog, from_frontier,
-                                         entries=entries)
+                                         entries=entries, source=source)
     base_text = oplog.checkout(plan.common).snapshot()
     plen = len(base_text)
 

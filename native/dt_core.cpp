@@ -2179,6 +2179,33 @@ i64 dt_dump_tracker(void* p, i64 cap, i64* ids, i64* len, i64* ol,
   return k;
 }
 
+// Delete-target table export: the last tracker's op-LV -> deleted-items
+// map (lv0, lv1, t0, t1, fwd rows; op lv0+k targets item t0+k when fwd,
+// t1-1-k when reversed). Recorded in apply order — callers sort by lv0.
+// Same two-call sizing protocol as dt_dump_tracker. A delete op's target
+// set is intrinsic to the op (fixed by its position + parent version),
+// so these rows are valid for ANY schedule over the same conflict zone —
+// the fork/join plan executor builds its write journal from them
+// (diamond_types_tpu/tpu/plan_kernels.py).
+i64 dt_dump_del_rows(void* p, i64 cap, i64* lv0, i64* lv1, i64* t0,
+                     i64* t1, u8* fwd) {
+  Ctx* c = (Ctx*)p;
+  if (!c->last_tracker) return 0;
+  const auto& dl = c->last_tracker->del_list;
+  i64 k = 0;
+  for (const DelRow& r : dl) {
+    if (k < cap) {
+      lv0[k] = r.lv0;
+      lv1[k] = r.lv1;
+      t0[k] = r.t0;
+      t1[k] = r.t1;
+      fwd[k] = r.fwd ? 1 : 0;
+    }
+    k++;
+  }
+  return k;
+}
+
 // Release the retained tracker + zone frontier (callers that are done
 // with dt_dump_tracker / dt_get_zone_common free the O(zone) tables).
 void dt_release_tracker(void* p) {

@@ -147,3 +147,41 @@ def test_tape_state_lattice_respected():
     plan, ex, tape, rows = snapshot_rows(ol, [])
     assert rows.max() <= 2
     assert set(np.unique(rows)) <= {NIY, INSERTED, 2}
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_tape_source_time_travel(seed):
+    """The C++-engine-backed tape source (no Python zone execution) must
+    produce the same historical texts as the Python-executor source and
+    the M1 checkout oracle."""
+    from diamond_types_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native core not built")
+    ol = _fuzz_oplog(300 + seed, steps=25, cross_sync=True)
+    plan, src, tape, rows = snapshot_rows(ol, [], entries=[],
+                                          source="native")
+    if not plan.entries:
+        pytest.skip("no conflict zone")
+    ks = list(range(0, len(plan.entries), 2))
+    texts_native = texts_at_versions(ol, ks, source="native")
+    texts_python = texts_at_versions(ol, ks, source="python")
+    assert texts_native == texts_python
+    for i, k in enumerate(ks):
+        f = entry_frontier(ol.cg.graph, plan, k)
+        assert texts_native[i] == ol.checkout(f).snapshot(), k
+
+
+def test_native_tape_source_incremental():
+    from diamond_types_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native core not built")
+    ol = _fuzz_oplog(77, steps=25, cross_sync=True)
+    mid = ol.cg.graph.find_dominators([len(ol) // 2])
+    plan, src, tape, rows = snapshot_rows(ol, mid, entries=[],
+                                          source="native")
+    if not plan.entries:
+        pytest.skip("no conflict zone")
+    ks = [0, len(plan.entries) - 1]
+    tn = texts_at_versions(ol, ks, from_frontier=mid, source="native")
+    tp = texts_at_versions(ol, ks, from_frontier=mid, source="python")
+    assert tn == tp
