@@ -505,19 +505,11 @@ def merge_via_plan2(oplog, from_frontier, merge_frontier,
 
 
 def apply_xf_stream(oplog, content, rows) -> str:
-    """Apply an xf stream to a str/Rope-like `content`; returns the new text
-    (the same application loop as Branch.merge's pure-Python path)."""
+    """Apply an xf stream to a str/Rope-like `content`; returns the new
+    text (delegates to Branch's shared application loop)."""
+    from ..text.branch import Branch
     from ..utils.rope import Rope
-    rope = Rope(str(content))
-    for _lv, op, pos in rows:
-        if pos is None:
-            continue
-        if op.kind == INS:
-            text = oplog.ops.get_run_content(op)
-            assert text is not None
-            if not op.fwd:
-                text = text[::-1]
-            rope.insert(pos, text)
-        else:
-            rope.delete(pos, len(op))
-    return str(rope)
+    b = Branch()
+    b.content = Rope(str(content))
+    b._apply_xf(oplog, rows)
+    return b.snapshot()

@@ -77,8 +77,19 @@ class Branch:
             linearization of the conflict zone, batched-friendly),
           * default — C++ host core when built (same algorithm as the
             Python engine, ~2 orders of magnitude faster),
+          * DT_TPU_PLAN2=1 — fork/join plan engine (compile the conflict
+            zone into a Begin/Fork/Max/Apply schedule over numbered state
+            indexes, execute against the dense state matrix — the
+            listmerge2 design; listmerge/plan2.py + dense.py),
           * DT_TPU_NO_NATIVE=1 — pure-Python engine (the oracle).
         """
+        if os.environ.get("DT_TPU_PLAN2"):
+            from ..listmerge.dense import merge_via_plan2
+            rows, final = merge_via_plan2(oplog, self.version,
+                                          merge_frontier)
+            self._apply_xf(oplog, rows)
+            self.version = list(final)
+            return
         if os.environ.get("DT_TPU_DEVICE_MERGE"):
             from ..tpu.merge_kernel import merge_device
             text, frontier = merge_device(oplog, self.version,
@@ -96,7 +107,13 @@ class Branch:
                 return
 
         xf = oplog.get_xf_operations_full(self.version, merge_frontier)
-        for _lv, op, pos in xf:
+        self._apply_xf(oplog, xf)
+        self.version = list(xf.next_frontier)
+
+    def _apply_xf(self, oplog: OpLog, rows) -> None:
+        """Apply an (lv, op, xf_pos|None) stream to this branch's content —
+        the one shared application loop for every host engine."""
+        for _lv, op, pos in rows:
             if pos is None:
                 continue  # delete already happened
             if op.kind == INS:
@@ -107,7 +124,6 @@ class Branch:
                 self.content.insert(pos, content)
             else:
                 self.content.delete(pos, len(op))
-        self.version = list(xf.next_frontier)
 
     def merge_tip(self, oplog: OpLog) -> None:
         self.merge(oplog, oplog.version)

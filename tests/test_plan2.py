@@ -143,3 +143,19 @@ def test_plan2_friendsforever_corpus():
     got, final = _checkout_text_plan2(ol)
     assert got == expected
     assert final == ol.version
+
+
+def test_branch_merge_plan2_backend(monkeypatch):
+    """DT_TPU_PLAN2=1 selects the fork/join engine behind the same
+    Branch.merge seam the other engines use (the reference keeps
+    listmerge2 behind the same boundary)."""
+    for seed in (3, 11):
+        ol = _fuzz_oplog(400 + seed, steps=25, cross_sync=True)
+        # oracle via the default engines, with the switch unset
+        monkeypatch.delenv("DT_TPU_PLAN2", raising=False)
+        oracle = ol.checkout_tip()
+        monkeypatch.setenv("DT_TPU_PLAN2", "1")
+        b = ol.checkout([])          # trivial []->[] merge, also plan2
+        b.merge(ol, ol.version)      # the real merge through plan2
+        assert b.snapshot() == oracle.snapshot()
+        assert b.version == oracle.version
