@@ -225,32 +225,52 @@ def bench_fanin_10k(n_rep: int = 10_000, timeout: int = 240):
     return _run_device_bench(code, timeout)
 
 
-def bench_linear_replay():
-    """BASELINE config 1: automerge-paper linear single-branch replay.
+def bench_linear_replay(trace: str = "automerge-paper.json.gz",
+                        full: bool = True):
+    """BASELINE config 1: linear single-branch trace replay.
 
     apply = per-op append path; apply_grouped = bulk columnar ingest
     (reference: crates/bench/src/main.rs local/apply_direct vs
     local/apply_grouped_rle — the reference also pre-groups outside the
-    timed apply)."""
+    timed apply). With full=False only the grouped ingest + checkout are
+    reported (the secondary traces)."""
     from diamond_types_tpu.text.trace import (load_trace, replay_into_oplog,
                                               replay_into_oplog_grouped)
-    data = load_trace(os.path.join(BENCH_DATA, "automerge-paper.json.gz"))
-    t0 = time.perf_counter()
-    ol = replay_into_oplog(data)
-    t_apply = time.perf_counter() - t0
+    data = load_trace(os.path.join(BENCH_DATA, trace))
     data.patch_columns()  # built at parse time, outside the timed apply
     t_grouped = min(
         _timed(lambda: replay_into_oplog_grouped(data)) for _ in range(3))
+    ol = replay_into_oplog_grouped(data)
     t0 = time.perf_counter()
     b = ol.checkout_tip()
     t_checkout = time.perf_counter() - t0
     n = data.num_ops()
-    return {
-        "apply_ops_per_sec": round(n / t_apply),
+    out = {
         "apply_grouped_ops_per_sec": round(n / t_grouped),
         "checkout_ops_per_sec": round(n / t_checkout),
         "parity": b.snapshot() == data.end_content,
     }
+    if full:
+        t0 = time.perf_counter()
+        replay_into_oplog(data)
+        out["apply_ops_per_sec"] = round(n / (time.perf_counter() - t0))
+    return out
+
+
+def bench_codec(name: str):
+    """Binary load + save timings for a shipped corpus (reference:
+    crates/bench/src/main.rs complex/decode + complex/encode)."""
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.encoding.encode import ENCODE_FULL, encode_oplog
+    with open(os.path.join(BENCH_DATA, name), "rb") as f:
+        data = f.read()
+    t_dec = min(_timed(lambda: load_oplog(data)) for _ in range(3))
+    ol = load_oplog(data)
+    t_enc = min(_timed(lambda: encode_oplog(ol, ENCODE_FULL))
+                for _ in range(3))
+    n = len(ol)
+    return {"decode_ops_per_sec": round(n / t_dec),
+            "encode_ops_per_sec": round(n / t_enc)}
 
 
 def _timed(fn):
