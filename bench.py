@@ -238,9 +238,9 @@ def bench_linear_replay(trace: str = "automerge-paper.json.gz",
                                               replay_into_oplog_grouped)
     data = load_trace(os.path.join(BENCH_DATA, trace))
     data.patch_columns()  # built at parse time, outside the timed apply
-    t_grouped = min(
-        _timed(lambda: replay_into_oplog_grouped(data)) for _ in range(3))
-    ol = replay_into_oplog_grouped(data)
+    t_grouped, ol = min(
+        (_timed(lambda: replay_into_oplog_grouped(data)) for _ in range(3)),
+        key=lambda p: p[0])
     t0 = time.perf_counter()
     b = ol.checkout_tip()
     t_checkout = time.perf_counter() - t0
@@ -252,8 +252,11 @@ def bench_linear_replay(trace: str = "automerge-paper.json.gz",
     }
     if full:
         t0 = time.perf_counter()
-        replay_into_oplog(data)
+        ol2 = replay_into_oplog(data)
         out["apply_ops_per_sec"] = round(n / (time.perf_counter() - t0))
+        # the per-op path must stay parity-gated too, not just timed
+        out["parity"] = out["parity"] and \
+            ol2.checkout_tip().snapshot() == data.end_content
     return out
 
 
@@ -264,9 +267,9 @@ def bench_codec(name: str):
     from diamond_types_tpu.encoding.encode import ENCODE_FULL, encode_oplog
     with open(os.path.join(BENCH_DATA, name), "rb") as f:
         data = f.read()
-    t_dec = min(_timed(lambda: load_oplog(data)) for _ in range(3))
-    ol = load_oplog(data)
-    t_enc = min(_timed(lambda: encode_oplog(ol, ENCODE_FULL))
+    t_dec, ol = min((_timed(lambda: load_oplog(data)) for _ in range(3)),
+                    key=lambda p: p[0])
+    t_enc = min(_timed(lambda: encode_oplog(ol, ENCODE_FULL))[0]
                 for _ in range(3))
     n = len(ol)
     return {"decode_ops_per_sec": round(n / t_dec),
@@ -275,8 +278,8 @@ def bench_codec(name: str):
 
 def _timed(fn):
     t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
+    out = fn()
+    return time.perf_counter() - t0, out
 
 
 def main() -> None:
@@ -326,6 +329,23 @@ def main() -> None:
         extra["automerge_linear"] = bench_linear_replay()
     except Exception as e:  # pragma: no cover
         extra["automerge_error"] = str(e)[:100]
+
+    # The reference's other linear traces (local/apply_* groups run all 5:
+    # crates/bench/src/main.rs:17) — grouped ingest + checkout per trace.
+    for trace in ("rustcode", "sveltecomponent", "seph-blog1"):
+        try:
+            extra[f"{trace.replace('-', '_')}_linear"] = \
+                bench_linear_replay(trace + ".json.gz", full=False)
+        except Exception as e:  # pragma: no cover
+            extra[f"{trace}_error"] = str(e)[:100]
+
+    # complex/decode + complex/encode (crates/bench/src/main.rs:112-144).
+    for corpus in ("git-makefile.dt", "node_nodecc.dt", "friendsforever.dt"):
+        key = corpus.split(".")[0].replace("-", "_")
+        try:
+            extra[f"{key}_codec"] = bench_codec(corpus)
+        except Exception as e:  # pragma: no cover
+            extra[f"{key}_codec_error"] = str(e)[:100]
 
     r = bench_tpu_batch()
     if r.get("ok"):
