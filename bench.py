@@ -333,11 +333,12 @@ def main() -> None:
     # The reference's other linear traces (local/apply_* groups run all 5:
     # crates/bench/src/main.rs:17) — grouped ingest + checkout per trace.
     for trace in ("rustcode", "sveltecomponent", "seph-blog1"):
+        key = trace.replace("-", "_")
         try:
-            extra[f"{trace.replace('-', '_')}_linear"] = \
+            extra[f"{key}_linear"] = \
                 bench_linear_replay(trace + ".json.gz", full=False)
         except Exception as e:  # pragma: no cover
-            extra[f"{trace}_error"] = str(e)[:100]
+            extra[f"{key}_error"] = str(e)[:100]
 
     # complex/decode + complex/encode (crates/bench/src/main.rs:112-144).
     for corpus in ("git-makefile.dt", "node_nodecc.dt", "friendsforever.dt"):

@@ -233,10 +233,75 @@ class _VersionMap:
         return r[1] + off, r[2] - off
 
 
+def _rebuild_from_native(oplog: OpLog, cols: dict) -> List[int]:
+    """Fill an empty OpLog from the C++ decoder's columns (native/core.py
+    decode_file_native). The op rows arrive pre-merged with push_op's RLE
+    rule, so the resulting tables are identical to the Python decoder's."""
+    from ..text.op import OpRun
+
+    if cols["doc_id"] is not None:
+        oplog.doc_id = cols["doc_id"]
+    local_agents = [oplog.get_or_create_agent_id(n)
+                    for n in cols["agent_names"]]
+    aa = oplog.cg.agent_assignment
+    ar_agent, ar_seq0, ar_n = cols["agent_runs"]
+    lv = 0
+    for i in range(len(ar_agent)):
+        n = int(ar_n[i])
+        aa.assign_span(local_agents[int(ar_agent[i])], int(ar_seq0[i]),
+                       lv, n)
+        lv += n
+
+    ins_base = oplog.ops._arenas[INS].push(cols["ins_blob"])[0]
+    del_base = oplog.ops._arenas[DEL].push(cols["del_blob"])[0]
+    assert ins_base == 0 and del_base == 0, "native decode needs fresh arenas"
+    (olv, okind, ostart, oend, ofwd, oknown, oclen) = cols["ops"]
+    runs = oplog.ops.runs
+    cpos = [0, 0]  # per-kind char cursor into the blobs
+    for i in range(len(olv)):
+        kind = int(okind[i])
+        if oknown[i]:
+            c0 = cpos[kind]
+            cp = (c0, c0 + int(oclen[i]))
+            cpos[kind] = cp[1]
+        else:
+            cp = None
+        runs.append(OpRun(int(olv[i]), kind, int(ostart[i]), int(oend[i]),
+                          bool(ofwd[i]), cp))
+
+    g_start, g_end, g_off, g_par = cols["graph"]
+    graph = oplog.cg.graph
+    for i in range(len(g_start)):
+        parents = [int(p) for p in g_par[g_off[i]:g_off[i + 1]]]
+        span = (int(g_start[i]), int(g_end[i]))
+        graph.push(parents, span[0], span[1])
+        graph._advance_known_run(oplog.cg.version, parents, span)
+    return list(oplog.cg.version)
+
+
 def decode_into(oplog: OpLog, data: bytes, ignore_crc: bool = False) -> List[int]:
     """Decode a .dt file, merging its ops into `oplog` (dedup-safe).
     Returns the file's frontier mapped to local LVs
-    (reference: decode_oplog.rs:590-960 decode_internal)."""
+    (reference: decode_oplog.rs:590-960 decode_internal).
+
+    Fresh loads (empty oplog) go through the native C++ parser when it is
+    available (native/dt_decode.cpp — same format, column for column);
+    patch files and decode-and-add merges use this Python path."""
+    import os
+    if len(oplog) == 0 and not ignore_crc \
+            and not os.environ.get("DT_TPU_NO_NATIVE"):
+        try:
+            from ..native.core import NativeParseError, decode_file_native
+        except ImportError:  # pragma: no cover - e.g. numpy-less install
+            cols = None
+        else:
+            try:
+                cols = decode_file_native(data)
+            except NativeParseError as e:
+                raise ParseError(str(e)) from None
+        if cols is not None:
+            return _rebuild_from_native(oplog, cols)
+
     if data[:8] != MAGIC:
         raise ParseError("bad magic")
     top = Buf(data, 8)

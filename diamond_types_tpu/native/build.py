@@ -8,17 +8,19 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SRC = os.path.join(REPO, "native", "dt_core.cpp")
+SRC_DECODE = os.path.join(REPO, "native", "dt_decode.cpp")
 OUT = os.path.join(REPO, "native", "libdt_core.so")
 
 
 def build(force: bool = False) -> str | None:
     if not os.path.exists(SRC):
         return None
+    srcs = [SRC] + ([SRC_DECODE] if os.path.exists(SRC_DECODE) else [])
     if not force and os.path.exists(OUT) and \
-            os.path.getmtime(OUT) >= os.path.getmtime(SRC):
+            all(os.path.getmtime(OUT) >= os.path.getmtime(s) for s in srcs):
         return OUT
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC", "-DNDEBUG",
-           SRC, "-o", OUT]
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-DNDEBUG", *srcs, "-o", OUT]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except (subprocess.CalledProcessError, FileNotFoundError) as e:

@@ -82,6 +82,43 @@ def _load():
         np.ctypeslib.ndpointer(np.int64, flags="C"),
         np.ctypeslib.ndpointer(np.uint8, flags="C")]
     lib.dt_dump_del_rows.restype = ct.c_int64
+    lib.dt_decode_new.argtypes = [
+        np.ctypeslib.ndpointer(np.uint8, flags="C"), ct.c_int64]
+    lib.dt_decode_new.restype = ct.c_void_p
+    lib.dt_decode_free.argtypes = [ct.c_void_p]
+    lib.dt_dec_status.argtypes = [ct.c_void_p]
+    lib.dt_dec_status.restype = ct.c_int64
+    lib.dt_dec_err.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_int64]
+    lib.dt_dec_err.restype = ct.c_int64
+    lib.dt_dec_counts.argtypes = [
+        ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_dec_strings.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C")]
+    lib.dt_dec_agent_runs.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_dec_ops.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_dec_graph.argtypes = [
+        ct.c_void_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C"),
+        np.ctypeslib.ndpointer(np.int64, flags="C")]
     lib.dt_get_zone_common.argtypes = [
         ct.c_void_p, np.ctypeslib.ndpointer(np.int64, flags="C"), ct.c_int64]
     lib.dt_get_zone_common.restype = ct.c_int64
@@ -275,6 +312,86 @@ EVENT_COUNTER_NAMES = (
     "integrate_calls", "integrate_scan_iters", "apply_ins_runs",
     "apply_del_runs", "advance_calls", "retreat_calls", "walk_steps",
     "diff_calls")
+
+
+class NativeParseError(Exception):
+    """Hard parse/corruption error reported by the native decoder."""
+
+
+def decode_file_native(data: bytes) -> Optional[dict]:
+    """Parse a v1 .dt file with the C++ decoder (fresh-load path only).
+
+    Returns a dict of columns, or None when the file shape needs the
+    Python decoder (patch files with a non-empty start version) or the
+    native library is unavailable. Raises NativeParseError on corrupt
+    input (same failures the Python decoder raises ParseError for)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    h = lib.dt_decode_new(np.ascontiguousarray(buf), len(data))
+    try:
+        status = lib.dt_dec_status(h)
+        if status != 0:
+            n = lib.dt_dec_err(h, None, 0)
+            msg = ct.create_string_buffer(int(n) + 1)
+            lib.dt_dec_err(h, msg, n)
+            if status == 1:
+                return None
+            raise NativeParseError(msg.value.decode("utf8", "replace"))
+        counts = np.zeros(10, dtype=np.int64)
+        lib.dt_dec_counts(h, counts)
+        (n_agents, names_bytes, n_aruns, n_ops, n_graph, n_par,
+         ins_bytes, del_bytes, has_doc_id, doc_bytes) = (int(x)
+                                                         for x in counts)
+        names = np.zeros(max(1, names_bytes), dtype=np.uint8)
+        name_lens = np.zeros(max(1, n_agents), dtype=np.int64)
+        ins_blob = np.zeros(max(1, ins_bytes), dtype=np.uint8)
+        del_blob = np.zeros(max(1, del_bytes), dtype=np.uint8)
+        doc_id = np.zeros(max(1, doc_bytes), dtype=np.uint8)
+        lib.dt_dec_strings(h, names, name_lens, ins_blob, del_blob, doc_id)
+        ar_agent = np.zeros(max(1, n_aruns), dtype=np.int64)
+        ar_seq0 = np.zeros(max(1, n_aruns), dtype=np.int64)
+        ar_n = np.zeros(max(1, n_aruns), dtype=np.int64)
+        lib.dt_dec_agent_runs(h, ar_agent, ar_seq0, ar_n)
+        op_lv = np.zeros(max(1, n_ops), dtype=np.int64)
+        op_kind = np.zeros(max(1, n_ops), dtype=np.uint8)
+        op_start = np.zeros(max(1, n_ops), dtype=np.int64)
+        op_end = np.zeros(max(1, n_ops), dtype=np.int64)
+        op_fwd = np.zeros(max(1, n_ops), dtype=np.uint8)
+        op_known = np.zeros(max(1, n_ops), dtype=np.uint8)
+        op_clen = np.zeros(max(1, n_ops), dtype=np.int64)
+        lib.dt_dec_ops(h, op_lv, op_kind, op_start, op_end, op_fwd,
+                       op_known, op_clen)
+        g_start = np.zeros(max(1, n_graph), dtype=np.int64)
+        g_end = np.zeros(max(1, n_graph), dtype=np.int64)
+        g_off = np.zeros(n_graph + 1, dtype=np.int64)
+        g_par = np.zeros(max(1, n_par), dtype=np.int64)
+        lib.dt_dec_graph(h, g_start, g_end, g_off, g_par)
+
+        names_b = names.tobytes()[:names_bytes]
+        agent_names = []
+        k = 0
+        for i in range(n_agents):
+            ln = int(name_lens[i])
+            agent_names.append(names_b[k:k + ln].decode("utf8"))
+            k += ln
+        return {
+            "doc_id": (doc_id.tobytes()[:doc_bytes].decode("utf8")
+                       if has_doc_id else None),
+            "agent_names": agent_names,
+            "agent_runs": (ar_agent[:n_aruns], ar_seq0[:n_aruns],
+                           ar_n[:n_aruns]),
+            "ops": (op_lv[:n_ops], op_kind[:n_ops], op_start[:n_ops],
+                    op_end[:n_ops], op_fwd[:n_ops], op_known[:n_ops],
+                    op_clen[:n_ops]),
+            "ins_blob": ins_blob.tobytes()[:ins_bytes].decode("utf8"),
+            "del_blob": del_blob.tobytes()[:del_bytes].decode("utf8"),
+            "graph": (g_start[:n_graph], g_end[:n_graph], g_off,
+                      g_par[:n_par]),
+        }
+    finally:
+        lib.dt_decode_free(h)
 
 
 def native_counters() -> Optional[dict]:
