@@ -348,6 +348,21 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             extra[f"{key}_codec_error"] = str(e)[:100]
 
+    # Peak-memory probe (reference: examples/posstats.rs behind the
+    # memusage feature / trace-alloc counting allocator). Python-side
+    # allocations only; the C++ tier's tables are outside tracemalloc.
+    try:
+        from diamond_types_tpu.utils.stats import peak_memory_probe
+        _, peak = peak_memory_probe(lambda: gm_ol.checkout_tip())
+        extra["merge_peak_py_bytes"] = int(peak)
+        from diamond_types_tpu.encoding.decode import load_oplog as _lo
+        with open(os.path.join(BENCH_DATA, "git-makefile.dt"), "rb") as f:
+            _data = f.read()
+        _, peak = peak_memory_probe(lambda: _lo(_data))
+        extra["decode_peak_py_bytes"] = int(peak)
+    except Exception as e:  # pragma: no cover
+        extra["memusage_error"] = str(e)[:100]
+
     r = bench_tpu_batch()
     if r.get("ok"):
         extra["tpu_batched_replay_ops_per_sec"] = round(r["value"])
