@@ -13,6 +13,13 @@ for _i in range(256):
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
+    try:
+        from ..native.core import crc32c_native
+        out = crc32c_native(data, crc)
+        if out is not None:
+            return out
+    except Exception:  # noqa: BLE001 - degrade to pure python on any failure
+        pass
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = (crc >> 8) ^ _TABLE[(crc ^ b) & 0xFF]

@@ -219,7 +219,8 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
     # --- main walk (reference: encode_oplog.rs:545-600) ---------------------
     _only_a, only_b = graph.diff_rev(from_version, oplog.cg.version)
     assert not _only_a, "from_version must be an ancestor of the oplog version"
-    walker = SpanningTreeWalker(graph, only_b, list(from_version))
+    walker = SpanningTreeWalker(graph, only_b, list(from_version),
+                                track_frontier=False)
     for walk in walker:
         span = walk.consume
         # 1. agent assignment runs

@@ -17,7 +17,17 @@ def lz4_compress_block(src: bytes) -> bytes:
     by the reference's lz4_flex reader. Spec constraints honored: matches are
     >= 4 bytes, offsets <= 0xFFFF, and the final 5 bytes (plus the 12-byte
     end-of-block window) are emitted as literals.
+
+    Delegates to the byte-identical native mirror when available (the two
+    are differential-tested; output must not depend on which one ran).
     """
+    try:
+        from ..native.core import lz4_compress_native
+        out = lz4_compress_native(src)
+        if out is not None:
+            return out
+    except Exception:  # noqa: BLE001 - degrade to pure python on any failure
+        pass
     n = len(src)
     out = bytearray()
     table: dict = {}

@@ -38,9 +38,18 @@ class WalkItem:
 
 class SpanningTreeWalker:
     def __init__(self, graph: Graph, rev_spans: Sequence[Span],
-                 start_at: List[int]) -> None:
+                 start_at: List[int], track_frontier: bool = True) -> None:
+        """With track_frontier=False the walker yields the same traversal
+        order and parents but skips the per-step frontier diff (the
+        retreat/advance lists come back empty) — for consumers like the
+        encoder that only need (consume, parents), this removes the
+        dominant graph-query cost."""
         self.graph = graph
-        self.frontier: List[int] = list(start_at)
+        self.track_frontier = track_frontier
+        # NOTE: with track_frontier=False, `frontier` is intentionally NOT
+        # maintained; reading it raises (see frontier property) so callers
+        # that copy the plan.py chaining pattern fail loudly.
+        self._frontier: List[int] = list(start_at)
         self.input: List[_VisitEntry] = []
         self.to_process: List[int] = []
 
@@ -110,13 +119,16 @@ class SpanningTreeWalker:
         parents = e.parents
         span = e.span
 
-        only_branch, only_txn = self.graph.diff_rev(self.frontier, list(parents))
-
-        for rng in only_branch:
-            self.graph.retreat_frontier(self.frontier, rng)
-        for rng in reversed(only_txn):
-            self.graph.advance_frontier(self.frontier, rng)
-        self.graph._advance_known_run(self.frontier, parents, span)
+        if self.track_frontier:
+            only_branch, only_txn = self.graph.diff_rev(self._frontier,
+                                                        list(parents))
+            for rng in only_branch:
+                self.graph.retreat_frontier(self._frontier, rng)
+            for rng in reversed(only_txn):
+                self.graph.advance_frontier(self._frontier, rng)
+            self.graph._advance_known_run(self._frontier, parents, span)
+        else:
+            only_branch, only_txn = [], []
 
         for c in e.child_idxs:
             ce = self.input[c]
@@ -126,3 +138,12 @@ class SpanningTreeWalker:
                 self.to_process.append(c)
 
         return WalkItem(only_branch, only_txn, parents, span)
+
+    @property
+    def frontier(self) -> List[int]:
+        if not self.track_frontier:
+            raise RuntimeError(
+                "walker built with track_frontier=False does not maintain "
+                "a frontier; construct with track_frontier=True to chain "
+                "walks from walker.frontier")
+        return self._frontier

@@ -113,6 +113,13 @@ def _load():
         np.ctypeslib.ndpointer(np.uint8, flags="C"),
         np.ctypeslib.ndpointer(np.uint8, flags="C"),
         np.ctypeslib.ndpointer(np.int64, flags="C")]
+    lib.dt_crc32c.argtypes = [
+        np.ctypeslib.ndpointer(np.uint8, flags="C"), ct.c_int64, ct.c_int64]
+    lib.dt_crc32c.restype = ct.c_int64
+    lib.dt_lz4_compress.argtypes = [
+        np.ctypeslib.ndpointer(np.uint8, flags="C"), ct.c_int64,
+        np.ctypeslib.ndpointer(np.uint8, flags="C"), ct.c_int64]
+    lib.dt_lz4_compress.restype = ct.c_int64
     lib.dt_dec_graph.argtypes = [
         ct.c_void_p,
         np.ctypeslib.ndpointer(np.int64, flags="C"),
@@ -312,6 +319,50 @@ EVENT_COUNTER_NAMES = (
     "integrate_calls", "integrate_scan_iters", "apply_ins_runs",
     "apply_del_runs", "advance_calls", "retreat_calls", "walk_steps",
     "diff_calls")
+
+
+_codec_lib = False  # False = not probed yet; None = unavailable
+
+
+def _codec_load():
+    """Like _load() but with negative caching and a broad exception guard:
+    the codec fast paths sit on hot per-record loops and must degrade to
+    the pure-Python implementations on ANY native failure (stale/ABI-
+    incompatible .so, missing symbols, failed build) without re-probing
+    per call."""
+    global _codec_lib
+    if _codec_lib is False:
+        try:
+            lib = _load()
+            if lib is not None:
+                lib.dt_crc32c  # symbol presence check (stale .so)
+                lib.dt_lz4_compress
+            _codec_lib = lib
+        except Exception:  # noqa: BLE001 - any failure means "no native"
+            _codec_lib = None
+    return _codec_lib
+
+
+def crc32c_native(data: bytes, seed: int = 0):
+    lib = _codec_load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.dt_crc32c(np.ascontiguousarray(buf), len(data), seed))
+
+
+def lz4_compress_native(data: bytes):
+    lib = _codec_load()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(np.frombuffer(data, dtype=np.uint8))
+    cap = len(data) + len(data) // 255 + 16
+    out = np.zeros(max(1, cap), dtype=np.uint8)
+    n = int(lib.dt_lz4_compress(buf, len(data), out, cap))
+    if n < 0:  # pragma: no cover - compression expanded past the estimate
+        out = np.zeros(-n, dtype=np.uint8)
+        n = int(lib.dt_lz4_compress(buf, len(data), out, -n))
+    return out[:n].tobytes()
 
 
 class NativeParseError(Exception):

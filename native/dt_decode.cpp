@@ -23,6 +23,7 @@
 #include <cstring>
 #include <memory>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 typedef int64_t i64;
@@ -717,6 +718,86 @@ void dt_dec_graph(void* h, i64* starts, i64* ends, i64* par_off,
     for (i64 p : d->graph[i].parents) par_flat[k++] = p;
   }
   par_off[d->graph.size()] = k;
+}
+
+i64 dt_crc32c(const u8* data, i64 n, i64 seed) {
+  // same table/reflection as dtdec::crc32c but with a caller seed so the
+  // Python incremental API (crc32c(data, crc)) maps 1:1
+  dtdec::crc_init();
+  uint32_t crc = (uint32_t)seed ^ 0xFFFFFFFFu;
+  for (i64 i = 0; i < n; i++)
+    crc = (crc >> 8) ^ dtdec::crc_table[(crc ^ data[i]) & 0xFF];
+  return (i64)(crc ^ 0xFFFFFFFFu);
+}
+
+// Greedy LZ4 block compression — a byte-identical mirror of the Python
+// lz4_compress_block (encoding/lz4.py): last-occurrence table keyed by the
+// EXACT 4-byte value (not a truncated hash), matches >= 4, offsets <=
+// 0xFFFF, final 5 bytes (+12-byte end window) literal. Byte identity
+// matters: encoder output must not depend on whether the native library
+// is loaded.
+i64 dt_lz4_compress(const u8* src, i64 n, u8* out, i64 cap) {
+  std::vector<u8> o;
+  o.reserve(n + n / 255 + 16);
+  std::unordered_map<uint32_t, i64> table;
+  i64 anchor = 0, i = 0;
+  i64 limit = n - 12;
+
+  auto emit = [&](i64 lit_start, i64 lit_end, i64 match_off, i64 match_len) {
+    i64 lit_len = lit_end - lit_start;
+    int token_lit = lit_len >= 15 ? 15 : (int)lit_len;
+    int token_match = 0;
+    if (match_len >= 0) {
+      i64 ml = match_len - 4;
+      token_match = ml >= 15 ? 15 : (int)ml;
+    }
+    o.push_back((u8)((token_lit << 4) | token_match));
+    if (lit_len >= 15) {
+      i64 rem = lit_len - 15;
+      while (rem >= 255) {
+        o.push_back(255);
+        rem -= 255;
+      }
+      o.push_back((u8)rem);
+    }
+    o.insert(o.end(), src + lit_start, src + lit_end);
+    if (match_len >= 0) {
+      o.push_back((u8)(match_off & 0xFF));
+      o.push_back((u8)(match_off >> 8));
+      if (match_len - 4 >= 15) {
+        i64 rem = match_len - 4 - 15;
+        while (rem >= 255) {
+          o.push_back(255);
+          rem -= 255;
+        }
+        o.push_back((u8)rem);
+      }
+    }
+  };
+
+  while (i < limit) {
+    uint32_t key;
+    std::memcpy(&key, src + i, 4);
+    auto it = table.find(key);
+    i64 cand = it == table.end() ? -1 : it->second;
+    table[key] = i;
+    if (cand >= 0 && i - cand <= 0xFFFF) {
+      i64 m = 4;
+      i64 max_m = n - 5 - i;
+      while (m < max_m && src[cand + m] == src[i + m]) m++;
+      if (m >= 4) {
+        emit(anchor, i, i - cand, m);
+        i += m;
+        anchor = i;
+        continue;
+      }
+    }
+    i++;
+  }
+  emit(anchor, n, 0, -1);
+  if ((i64)o.size() > cap) return -(i64)o.size();  // caller re-sizes
+  std::memcpy(out, o.data(), o.size());
+  return (i64)o.size();
 }
 
 }  // extern "C"

@@ -92,3 +92,38 @@ def test_decode_is_idempotent(seed):
     decode_into(peer, data)  # merging the same data again is a no-op
     assert len(peer) == n
     assert semantic_eq(ol, peer)
+
+
+def test_native_lz4_crc_byte_identical_to_python():
+    """The native LZ4 compressor and CRC-32C must be byte-identical to the
+    Python implementations — encoder output cannot depend on whether the
+    native library is loaded."""
+    import random as _r
+
+    from diamond_types_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native core not built")
+    import diamond_types_tpu.native.core as nc
+    from diamond_types_tpu.encoding import crc32c as C
+    from diamond_types_tpu.encoding import lz4 as L
+    rng = _r.Random(17)
+    real_lz4, real_crc = nc.lz4_compress_native, nc.crc32c_native
+    try:
+        for _ in range(60):
+            n = rng.randrange(0, 2500)
+            alphabet = 4 if rng.random() < 0.5 else 256
+            data = bytes(rng.randrange(alphabet) for _ in range(n))
+            a = real_lz4(data)
+            nc.lz4_compress_native = lambda d: None  # force python path
+            b = L.lz4_compress_block(data)
+            nc.lz4_compress_native = real_lz4
+            assert a == b
+            assert L.lz4_decompress_block(a, n) == data
+            ac = real_crc(data)
+            nc.crc32c_native = lambda d, s=0: None
+            bc = C.crc32c(data)
+            nc.crc32c_native = real_crc
+            assert ac == bc
+    finally:
+        nc.lz4_compress_native = real_lz4
+        nc.crc32c_native = real_crc
