@@ -5,8 +5,9 @@ wiki/server/server.ts:1-60 — an HTTP server holding an OpLog per document,
 exchanging patches with clients, persisting .dt files with rate-limited
 autosave; wiki/client/dt_doc.ts — the client keeping a local OpLog in sync).
 
-Protocol (JSON/binary over HTTP; the braid-stream equivalent is simple
-long-poll-free pull/push — each payload is a v1-format binary patch):
+Protocol (JSON/binary over HTTP; peer sync is stateless pull/push of
+v1-format binary patches, and the browser tier's /changes endpoint
+long-polls as the braid-subscription equivalent):
 
   GET  /doc/{id}            -> current document text
   GET  /doc/{id}/summary    -> version summary JSON
@@ -26,8 +27,13 @@ web_assets.py for the pages):
                             pos, text} | {kind:"del", start, end}]}
                             -> {"version": ...} (ops applied AT that
                             version; concurrent edits merge via the CRDT)
-  POST /doc/{id}/changes    body {"version": ...} -> {"op": traversal,
-                            "version": ...} — OT catch-up since `version`
+  POST /doc/{id}/changes    body {"version": ..., "wait": seconds?} ->
+                            {"op": traversal, "version": ...} — OT
+                            catch-up since `version`; with `wait` the
+                            request long-polls until new ops arrive or
+                            the timeout lapses (the braid-subscription
+                            equivalent: the reference wiki server streams
+                            patches to subscribed clients)
   GET  /doc/{id}/graph      -> causal DAG runs JSON (visualizer data)
   POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
 
@@ -67,6 +73,20 @@ class DocStore:
         self.docs: Dict[str, OpLog] = {}
         self.dirty: Dict[str, float] = {}
         self.lock = threading.Lock()
+        # Long-poll wakeups (one condition per doc; notified on new ops).
+        self._conds: Dict[str, threading.Condition] = {}
+
+    def cond(self, doc_id: str) -> threading.Condition:
+        with self.lock:
+            c = self._conds.get(doc_id)
+            if c is None:
+                c = self._conds[doc_id] = threading.Condition()
+            return c
+
+    def notify(self, doc_id: str) -> None:
+        c = self.cond(doc_id)
+        with c:
+            c.notify_all()
 
     def _path(self, doc_id: str) -> Optional[str]:
         if self.data_dir is None:
@@ -193,6 +213,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                 decode_into(ol, body)
             self.store.mark_dirty(doc_id)
             self.store.flush()
+            self.store.notify(doc_id)
             return self._send(200, b'{"ok": true}')
         if action == "edit":
             req = json.loads(body)
@@ -227,20 +248,37 @@ class SyncHandler(BaseHTTPRequestHandler):
                 out = ol.cg.local_to_remote_frontier(frontier)
             self.store.mark_dirty(doc_id)
             self.store.flush()
+            self.store.notify(doc_id)
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
         if action == "changes":
             from ..text import ot
             req = json.loads(body or b"{}")
-            with self.store.lock:
-                frontier = list(ol.cg.remote_to_local_frontier(
-                    req.get("version") or []))
-                trav = ot.xf_stream_to_traversal(
-                    ol.iter_xf_operations_from(frontier, ol.version))
-                out = {"op": trav,
-                       "version": ol.cg.local_to_remote_frontier(
-                           ol.cg.graph.version_union(frontier, ol.version))}
-            return self._send(200, json.dumps(out).encode("utf8"))
+            try:
+                wait = min(max(float(req.get("wait") or 0), 0.0), 60.0)
+            except (TypeError, ValueError):
+                return self._send(400, b'{"error": "bad wait"}')
+            deadline = time.monotonic() + wait
+            c = self.store.cond(doc_id)
+            # The condition is held around BOTH the emptiness check and the
+            # wait (notify_all also runs under it), so a notify can never
+            # land in between and be lost.
+            with c:
+                while True:
+                    with self.store.lock:
+                        frontier = list(ol.cg.remote_to_local_frontier(
+                            req.get("version") or []))
+                        trav = ot.xf_stream_to_traversal(
+                            ol.iter_xf_operations_from(frontier, ol.version))
+                        out = {"op": trav,
+                               "version": ol.cg.local_to_remote_frontier(
+                                   ol.cg.graph.version_union(frontier,
+                                                             ol.version))}
+                    remaining = deadline - time.monotonic()
+                    if trav or remaining <= 0:
+                        return self._send(200,
+                                          json.dumps(out).encode("utf8"))
+                    c.wait(timeout=min(remaining, 5.0))
         if action == "at":
             req = json.loads(body)
             with self.store.lock:

@@ -52,6 +52,7 @@ const DOC = "__DOC__";
 const AGENT = "web-" + Math.random().toString(36).slice(2, 8);
 const ta = document.getElementById("t"), st = document.getElementById("st");
 let version = null, shadow = "", inflight = false, queue = [];
+let pollFails = 0;
 
 const api = (path, body) => fetch(`/doc/${DOC}/${path}`, {
   method: "POST", body: JSON.stringify(body)}).then(r => r.json());
@@ -111,7 +112,9 @@ async function poll() {
   if (!inflight && !queue.length) {
     const v0 = version;
     try {
-      const r = await api("changes", {version: v0});
+      // long-poll: the server holds the request until new ops arrive
+      // (braid-subscription equivalent), so remote edits appear promptly
+      const r = await api("changes", {version: v0, wait: 20});
       // An edit raced the request: its response version superseded v0 and
       // the traversal below would replay our own op. Drop this round.
       if (!inflight && !queue.length && version === v0) {
@@ -124,9 +127,12 @@ async function poll() {
         version = r.version;
         st.textContent = `synced · version ${JSON.stringify(version)}`;
       }
-    } catch (e) { st.textContent = "sync lost: " + e; }
+      pollFails = 0;
+    } catch (e) { st.textContent = "sync lost: " + e; pollFails++; }
   }
-  setTimeout(poll, 700);
+  // fast re-poll after a successful long-poll; back off when the server
+  // is unreachable so dead tabs don't hammer it
+  setTimeout(poll, pollFails ? Math.min(500 << pollFails, 8000) : 150);
 }
 
 (async () => {

@@ -181,3 +181,46 @@ def test_edit_endpoint_rejects_bad_ops(tmp_path):
             assert r.read().decode() == "hello"
     finally:
         httpd.shutdown()
+
+
+def test_changes_long_poll_streams_edits(tmp_path):
+    """A waiting /changes request returns as soon as another client edits
+    (braid-subscription equivalent of the reference wiki streaming)."""
+    import time as _time
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        w = DumbClient(base, "lp", "writer")
+        w.edit([{"kind": "ins", "pos": 0, "text": "start"}])
+        r = DumbClient(base, "lp", "reader")
+        r.sync()
+        result = {}
+
+        def waiter():
+            t0 = _time.monotonic()
+            resp = _api(base, "lp", "changes",
+                        {"version": r.version, "wait": 10})
+            result["latency"] = _time.monotonic() - t0
+            result["resp"] = resp
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        _time.sleep(0.4)                 # waiter is now parked
+        w.edit([{"kind": "ins", "pos": 5, "text": "!"}])
+        th.join(timeout=8)
+        assert not th.is_alive(), "long-poll never woke"
+        assert result["latency"] < 5, "woke by timeout, not by notify"
+        from diamond_types_tpu.text import ot
+        assert ot.apply(r.text, result["resp"]["op"]) == "start!"
+
+        # and an idle wait times out quickly with an empty traversal
+        r.sync()
+        t0 = _time.monotonic()
+        resp = _api(base, "lp", "changes", {"version": r.version,
+                                            "wait": 0.5})
+        assert resp["op"] == [] and _time.monotonic() - t0 < 3
+    finally:
+        httpd.shutdown()
