@@ -81,6 +81,13 @@ def _run_device_bench(code: str, timeout: int):
         return {"ok": False, "why": f"spawn failed: {e}"}
 
     out = {}
+    if "DEVICE_UNRESPONSIVE" in stdout:
+        return {"ok": False,
+                "why": "device unresponsive (liveness probe timed out after "
+                       "60s; tunnel/backend wedged)",
+                "platform": next((ln.split(None, 1)[1] for ln in
+                                  stdout.splitlines()
+                                  if ln.startswith("PLATFORM ")), "?")}
     for line in stdout.splitlines():
         if line.startswith("RESULT "):
             out["ok"] = True
@@ -106,14 +113,31 @@ def _run_device_bench(code: str, timeout: int):
 # explicit env request via the config API (before backend init) keeps the
 # snippets smoke-testable on CPU while defaulting to the chip.
 _PRELUDE = """
-import sys, os, time
+import sys, os, threading, time
 sys.path.insert(0, {repo!r})
 import numpy as np
+
+# A wedged device/tunnel otherwise burns the full subprocess timeout. A
+# watchdog THREAD (not SIGALRM: a C-blocked init call never returns to
+# the interpreter, so a Python signal handler would not run) gives init +
+# one trivial forced-transfer op 60s, then fails fast and precisely.
+_live = threading.Event()
+
+def _watchdog():
+    if not _live.wait(60):
+        print("DEVICE_UNRESPONSIVE liveness probe did not complete",
+              flush=True)
+        os._exit(3)
+
+threading.Thread(target=_watchdog, daemon=True).start()
 import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 import jax.numpy as jnp
 print("PLATFORM", jax.devices()[0].platform, flush=True)
+np.asarray(jnp.arange(4) + 1)   # liveness: forces a real device round-trip
+_live.set()
+print("DEVICE_LIVE 1", flush=True)
 
 def bench_call(fn, fetch, reps=5):
     # Time fn() end to end, forcing completion by TRANSFERRING a small
