@@ -36,6 +36,10 @@ PUBLISHED_REPLAY_OPS_PER_SEC = 259_778 / 0.012
 
 BENCH_DATA = "/root/reference/benchmark_data"
 
+# Device liveness window (seconds): the snippet prelude's watchdog allows
+# this long for backend init + one forced-transfer op before failing fast.
+LIVENESS_S = 60
+
 
 def bench_merge(name: str, repeats: int = 3):
     from diamond_types_tpu.encoding.decode import load_oplog
@@ -83,8 +87,10 @@ def _run_device_bench(code: str, timeout: int):
     out = {}
     if "DEVICE_UNRESPONSIVE" in stdout:
         return {"ok": False,
-                "why": "device unresponsive (liveness probe timed out after "
-                       "60s; tunnel/backend wedged)",
+                "why": f"device unresponsive (liveness probe timed out "
+                       f"after {LIVENESS_S}s; tunnel/backend wedged)",
+                "tail": stderr.strip().splitlines()[-1][:200]
+                if stderr.strip() else "",
                 "platform": next((ln.split(None, 1)[1] for ln in
                                   stdout.splitlines()
                                   if ln.startswith("PLATFORM ")), "?")}
@@ -120,11 +126,11 @@ import numpy as np
 # A wedged device/tunnel otherwise burns the full subprocess timeout. A
 # watchdog THREAD (not SIGALRM: a C-blocked init call never returns to
 # the interpreter, so a Python signal handler would not run) gives init +
-# one trivial forced-transfer op 60s, then fails fast and precisely.
+# one trivial forced-transfer op {liveness}s, then fails fast precisely.
 _live = threading.Event()
 
 def _watchdog():
-    if not _live.wait(60):
+    if not _live.wait({liveness}):
         print("DEVICE_UNRESPONSIVE liveness probe did not complete",
               flush=True)
         os._exit(3)
@@ -174,7 +180,7 @@ def bench_tpu_batch(batch: int = 1024, n_ops: int = 256, cap: int = 1024,
     """Batched multi-doc replay on the real chip (BASELINE config 4 shape)."""
     code = _TPU_BENCH_SNIPPET.format(
         repo=os.path.dirname(os.path.abspath(__file__)),
-        batch=batch, n_ops=n_ops, cap=cap)
+        batch=batch, n_ops=n_ops, cap=cap, liveness=LIVENESS_S)
     return _run_device_bench(code, timeout)
 
 
@@ -213,7 +219,8 @@ def bench_device_merge(corpus: str, chunk: int, timeout: int = 480):
     case that stresses linearization)."""
     code = _MERGE_KERNEL_SNIPPET.format(
         repo=os.path.dirname(os.path.abspath(__file__)),
-        data=os.path.join(BENCH_DATA, corpus), chunk=chunk)
+        data=os.path.join(BENCH_DATA, corpus), chunk=chunk,
+        liveness=LIVENESS_S)
     return _run_device_bench(code, timeout)
 
 
@@ -245,7 +252,8 @@ def bench_fanin_10k(n_rep: int = 10_000, timeout: int = 240):
     is validated by tests/test_tpu_kernels.py::test_sharded_10k_replica_
     fanin and the driver's multichip dryrun."""
     code = _FANIN_SNIPPET.format(
-        repo=os.path.dirname(os.path.abspath(__file__)), n_rep=n_rep)
+        repo=os.path.dirname(os.path.abspath(__file__)), n_rep=n_rep,
+        liveness=LIVENESS_S)
     return _run_device_bench(code, timeout)
 
 
