@@ -279,6 +279,33 @@ def _rebuild_from_native(oplog: OpLog, cols: dict) -> List[int]:
     return list(oplog.cg.version)
 
 
+_native_decode_ok = True  # negative cache: set False on any native failure
+
+
+def _try_decode_native(data: bytes):
+    """Native fresh-load probe with the same broad exception guard +
+    negative caching the codec paths use (native/core.py::_codec_load):
+    ANY native failure — missing .so, CDLL OSError, stale ABI missing
+    dt_decode_new — degrades to the Python decoder instead of breaking
+    load_oplog. Genuine corruption (NativeParseError) still raises: the
+    Python decoder would reject the same bytes."""
+    global _native_decode_ok
+    if not _native_decode_ok:
+        return None
+    try:
+        from ..native.core import NativeParseError, decode_file_native
+    except ImportError:  # pragma: no cover - e.g. numpy-less install
+        _native_decode_ok = False
+        return None
+    try:
+        return decode_file_native(data)
+    except NativeParseError as e:
+        raise ParseError(str(e)) from None
+    except Exception:  # noqa: BLE001 - any failure means "no native"
+        _native_decode_ok = False
+        return None
+
+
 def decode_into(oplog: OpLog, data: bytes, ignore_crc: bool = False) -> List[int]:
     """Decode a .dt file, merging its ops into `oplog` (dedup-safe).
     Returns the file's frontier mapped to local LVs
@@ -290,15 +317,7 @@ def decode_into(oplog: OpLog, data: bytes, ignore_crc: bool = False) -> List[int
     import os
     if len(oplog) == 0 and not ignore_crc \
             and not os.environ.get("DT_TPU_NO_NATIVE"):
-        try:
-            from ..native.core import NativeParseError, decode_file_native
-        except ImportError:  # pragma: no cover - e.g. numpy-less install
-            cols = None
-        else:
-            try:
-                cols = decode_file_native(data)
-            except NativeParseError as e:
-                raise ParseError(str(e)) from None
+        cols = _try_decode_native(data)
         if cols is not None:
             return _rebuild_from_native(oplog, cols)
 

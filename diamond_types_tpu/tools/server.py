@@ -75,6 +75,31 @@ class DocStore:
         self.lock = threading.Lock()
         # Long-poll wakeups (one condition per doc; notified on new ops).
         self._conds: Dict[str, threading.Condition] = {}
+        self._stop = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+
+    def start_flusher(self) -> None:
+        """Run autosave on a background thread so the (lock-holding) encode
+        never stalls request handlers (reference: the wiki server's
+        rate-limited autosave is a timer, not inline in handlers)."""
+        if self.data_dir is None or self._flusher is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(max(self.save_interval, 0.25)):
+                try:
+                    self.flush()
+                except OSError:  # pragma: no cover - disk full etc.
+                    pass
+
+        self._flusher = threading.Thread(target=loop, daemon=True)
+        self._flusher.start()
+
+    def stop_flusher(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=2)
+            self._flusher = None
 
     def cond(self, doc_id: str) -> threading.Condition:
         with self.lock:
@@ -116,17 +141,23 @@ class DocStore:
             return
         os.makedirs(self.data_dir, exist_ok=True)
         now = time.monotonic()
+        # Encode UNDER the lock (/push and /edit mutate oplogs under it; an
+        # encode racing a mutation could crash or persist a torn snapshot);
+        # only the disk write happens outside it.
+        blobs = []
         with self.lock:
             due = [d for d, t in self.dirty.items()
                    if force or now - t >= self.save_interval]
             for d in due:
                 del self.dirty[d]
-        for doc_id in due:
-            ol = self.get(doc_id)
+                ol = self.docs.get(d)
+                if ol is not None:
+                    blobs.append((d, encode_oplog(ol, ENCODE_FULL)))
+        for doc_id, blob in blobs:
             path = self._path(doc_id)
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
-                f.write(encode_oplog(ol, ENCODE_FULL))
+                f.write(blob)
             os.replace(tmp, path)  # atomic
 
 
@@ -196,6 +227,21 @@ class SyncHandler(BaseHTTPRequestHandler):
         return self._send(404, b"{}")
 
     def do_POST(self):
+        # Malformed JSON bodies / missing keys / non-numeric values on any
+        # browser endpoint — and corrupt binary patches on /push
+        # (ParseError) — are client errors, not handler-thread crashes.
+        from ..encoding.decode import ParseError
+        try:
+            self._do_post()
+        except (ValueError, KeyError, TypeError, ParseError) as e:
+            try:
+                self._send(400, json.dumps(
+                    {"error": f"bad request: {e.__class__.__name__}"})
+                    .encode("utf8"))
+            except OSError:
+                pass  # client already gone
+
+    def _do_post(self):
         doc_id, action = self._route()
         if doc_id is None:
             return self._send(404, b"{}")
@@ -212,11 +258,26 @@ class SyncHandler(BaseHTTPRequestHandler):
             with self.store.lock:
                 decode_into(ol, body)
             self.store.mark_dirty(doc_id)
-            self.store.flush()
             self.store.notify(doc_id)
             return self._send(200, b'{"ok": true}')
         if action == "edit":
             req = json.loads(body)
+            # Normalize each op ONCE (ints coerced exactly once, via
+            # operator.index so floats like 3.7 are rejected, not
+            # truncated) and use the normalized list for BOTH validation
+            # and application — a value that passes validation can then
+            # never reach the oplog in a different form.
+            from operator import index as _ix
+            ops = []
+            for op in req["ops"]:
+                if op.get("kind") == "ins":
+                    ops.append(("ins", _ix(op["pos"]), op.get("text")))
+                elif op.get("kind") == "del":
+                    ops.append(("del", _ix(op["start"]), _ix(op["end"])))
+                else:
+                    return self._send(400, b'{"error": "bad op"}')
+            if not isinstance(req.get("agent"), str) or not req["agent"]:
+                return self._send(400, b'{"error": "bad agent"}')
             with self.store.lock:
                 frontier = list(ol.cg.remote_to_local_frontier(
                     req.get("version") or []))
@@ -224,30 +285,28 @@ class SyncHandler(BaseHTTPRequestHandler):
                 # client's version before touching the oplog: a rejected op
                 # must not leave earlier batch ops half-applied.
                 blen = len(ol.checkout(frontier))
-                for op in req["ops"]:
-                    if op["kind"] == "ins":
-                        if not (isinstance(op.get("text"), str) and op["text"]
-                                and 0 <= int(op["pos"]) <= blen):
+                for op in ops:
+                    if op[0] == "ins":
+                        _k, pos, text = op
+                        if not (isinstance(text, str) and text
+                                and 0 <= pos <= blen):
                             return self._send(400, b'{"error": "bad op"}')
-                        blen += len(op["text"])
-                    elif op["kind"] == "del":
-                        if not 0 <= int(op["start"]) < int(op["end"]) <= blen:
-                            return self._send(400, b'{"error": "bad op"}')
-                        blen -= int(op["end"]) - int(op["start"])
+                        blen += len(text)
                     else:
-                        return self._send(400, b'{"error": "bad op"}')
+                        _k, start, end = op
+                        if not 0 <= start < end <= blen:
+                            return self._send(400, b'{"error": "bad op"}')
+                        blen -= end - start
                 agent = ol.get_or_create_agent_id(req["agent"])
-                for op in req["ops"]:
-                    if op["kind"] == "ins":
-                        lv = ol.add_insert_at(agent, frontier, op["pos"],
-                                              op["text"])
+                for op in ops:
+                    if op[0] == "ins":
+                        lv = ol.add_insert_at(agent, frontier, op[1], op[2])
                     else:
-                        lv = ol.add_delete_at(agent, frontier, op["start"],
-                                              op["end"], None)
+                        lv = ol.add_delete_at(agent, frontier, op[1],
+                                              op[2], None)
                     frontier = [lv]
                 out = ol.cg.local_to_remote_frontier(frontier)
             self.store.mark_dirty(doc_id)
-            self.store.flush()
             self.store.notify(doc_id)
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
@@ -280,20 +339,39 @@ class SyncHandler(BaseHTTPRequestHandler):
                                           json.dumps(out).encode("utf8"))
                     c.wait(timeout=min(remaining, 5.0))
         if action == "at":
+            from operator import index as _ix
             req = json.loads(body)
+            try:
+                lv = _ix(req["lv"])
+            except (TypeError, KeyError):
+                return self._send(400, b'{"error": "bad lv"}')
             with self.store.lock:
-                f = ol.cg.graph.find_dominators([int(req["lv"])])
+                if not 0 <= lv < len(ol):
+                    return self._send(400, b'{"error": "lv out of range"}')
+                f = ol.cg.graph.find_dominators([lv])
                 text = ol.checkout(f).snapshot()
             return self._send(200, json.dumps({"text": text})
                               .encode("utf8"))
         return self._send(404, b"{}")
 
 
+class _Server(ThreadingHTTPServer):
+    store: DocStore = None
+
+    def server_close(self):  # final flush on clean shutdown
+        if self.store is not None:
+            self.store.stop_flusher()
+            self.store.flush(force=True)
+        super().server_close()
+
+
 def serve(port: int = 8008, data_dir: Optional[str] = None
           ) -> ThreadingHTTPServer:
     store = DocStore(data_dir)
     handler = type("Handler", (SyncHandler,), {"store": store})
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    httpd = _Server(("127.0.0.1", port), handler)
+    httpd.store = store
+    store.start_flusher()
     return httpd
 
 

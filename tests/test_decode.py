@@ -112,3 +112,35 @@ def test_native_decoder_fuzz_roundtrips():
         data = encode_oplog(ol, ENCODE_FULL)
         ol2 = load_oplog(data)
         assert semantic_eq(ol, ol2), seed
+
+
+def test_native_probe_failure_degrades_to_python(monkeypatch):
+    """A broken native library (CDLL OSError, stale ABI AttributeError)
+    must degrade the fresh-load fast path to the Python decoder, not break
+    load_oplog (ADVICE r2). The failure is negative-cached."""
+    from diamond_types_tpu.encoding import decode as dec
+    from diamond_types_tpu.encoding.encode import ENCODE_FULL, encode_oplog
+    from diamond_types_tpu.text.oplog import OpLog
+
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    ol.add_insert(a, 0, "hello")
+    data = encode_oplog(ol, ENCODE_FULL)
+
+    calls = []
+
+    def boom(_data):
+        calls.append(1)
+        raise OSError("simulated stale .so")
+
+    import diamond_types_tpu.native.core as ncore
+    monkeypatch.setattr(ncore, "decode_file_native", boom)
+    monkeypatch.setattr(dec, "_native_decode_ok", True)
+    try:
+        ol2 = dec.load_oplog(data)
+        assert ol2.checkout_tip().snapshot() == "hello"
+        ol3 = dec.load_oplog(data)  # negative-cached: no second probe
+        assert ol3.checkout_tip().snapshot() == "hello"
+        assert len(calls) == 1
+    finally:
+        monkeypatch.setattr(dec, "_native_decode_ok", True)

@@ -179,6 +179,81 @@ def test_edit_endpoint_rejects_bad_ops(tmp_path):
         import urllib.request as u
         with u.urlopen(f"{base}/doc/v") as r:
             assert r.read().decode() == "hello"
+
+        # Coerced-validation hole (ADVICE r2): a float pos passes int()
+        # validation but must not reach add_insert_at unconverted -> 400.
+        for bad in ([{"kind": "ins", "pos": 1.5, "text": "x"}],
+                    [{"kind": "ins", "pos": "2", "text": "x"}],
+                    [{"kind": "del", "start": 0.5, "end": 2}]):
+            try:
+                _api(base, "v", "edit",
+                     {"agent": "web", "version": w.version, "ops": bad})
+                raise AssertionError(f"accepted non-int op {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        # Malformed bodies on browser endpoints -> 400, not a closed
+        # connection / handler crash (ADVICE r2).
+        for action, payload in (
+                ("at", {}),                      # missing lv
+                ("at", {"lv": "zero"}),          # non-numeric lv
+                ("at", {"lv": 10**9}),           # out of range lv
+                ("at", {"lv": -1}),              # negative lv
+                ("edit", {"agent": "web"}),      # missing ops
+                ("edit", {"agent": 7, "version": [],
+                          "ops": [{"kind": "ins", "pos": 0, "text": "x"}]}),
+                ("changes", {"wait": "soon"})):  # non-numeric wait
+            try:
+                _api(base, "v", action, payload)
+                raise AssertionError(f"accepted bad {action} {payload}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        # Raw non-JSON body -> 400 as well.
+        req = urllib.request.Request(f"{base}/doc/v/at", data=b"not json")
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("accepted non-JSON body")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        with u.urlopen(f"{base}/doc/v") as r:
+            assert r.read().decode() == "hello"
+    finally:
+        httpd.shutdown()
+
+
+def test_flush_races_concurrent_edits(tmp_path):
+    """Autosave encoding must run under the store lock: hammer /edit from
+    two threads while forcing flushes; the persisted .dt must always load
+    (ADVICE r2 medium: flush() used to encode outside the lock)."""
+    from diamond_types_tpu.encoding.decode import load_oplog
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    store = httpd.RequestHandlerClass.store
+    store.save_interval = 0.0  # every flush() call is "due"
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        errs = []
+
+        def hammer(name):
+            try:
+                w = DumbClient(base, "r", name)
+                for i in range(40):
+                    w.edit([{"kind": "ins", "pos": 0, "text": f"{name}{i} "}])
+                    w.sync()
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=hammer, args=(n,))
+              for n in ("alice", "bob")]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        assert not errs
+        store.flush(force=True)
+        ol = load_oplog((tmp_path / "r.dt").read_bytes())
+        assert len(ol) > 0 and "alice0" in ol.checkout_tip().snapshot()
     finally:
         httpd.shutdown()
 
