@@ -445,7 +445,9 @@ def _run_device_phase(full: dict) -> dict:
     probe = device_probe()
     full["device_probe"] = probe
     if not probe.get("ok"):
-        msg = "device probe failed twice: " + _short_err(probe)
+        attempts = "twice" if probe.get("retried") else "once (no retry: " \
+            "failure signature is not a wedge)"
+        msg = f"device probe failed {attempts}: " + _short_err(probe)
         for k in ("tpu_batched_replay", "fanin_10k", "tpu_merge_git_makefile",
                   "tpu_merge_friendsforever", "tpu_merge_node_nodecc_sweep"):
             out[f"{k}_error"] = msg

@@ -1,0 +1,452 @@
+"""Per-entry op composition — the host half of device origin extraction.
+
+A conflict-zone entry (plan2.SubgraphEntry) is a linear run of ops whose
+positions are each relative to the document as the entry's own previous
+ops left it. The M1 engine resolves those positions one op at a time with
+a tracker cursor (reference: src/listmerge/merge.rs:395-423 — the per-op
+origin scan). This module instead *composes* each entry's ops into
+ENTRY-START coordinates with a piece table, so that:
+
+  * every position the device must resolve is relative to one frozen
+    snapshot (the doc at the entry's parent version) — resolvable for the
+    whole entry with two prefix sums (tpu/zone_kernel.py);
+  * the entry's own inserted chars are grouped into "blocks" (maximal
+    runs of own chars between snapshot chars). Each block has exactly one
+    snapshot-anchored ROOT run; every other run in the block chains off
+    own chars and therefore never competes with concurrent siblings (a
+    concurrent op cannot anchor onto chars it cannot causally see), so
+    only the root needs the YjsMod sibling comparison.
+
+Composition is pure control flow over the op table: no tracker, no text,
+no M1 transform. It replaces the full `ctx.transform` call the round-2
+device path still depended on (VERDICT r2 missing #1).
+
+Piece-table semantics mirror the tracker cursor exactly:
+  * the insert cursor lands immediately after the visible char at pos-1,
+    BEFORE any adjacent tombstones (merge.rs cursor positioning);
+  * deleted pieces stay in the table as tombstones — they are origin-
+    right candidates (origin_right skips only NotInsertedYet items,
+    merge.rs:407-424, and chars this entry deleted were alive in the
+    snapshot, so the device resolves them identically);
+  * delete targets are recorded against snapshot coords (for snapshot
+    chars) or own char ids (for chars this entry inserted itself).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..text.op import INS
+
+# Sentinel "infinite" snapshot length: the composer cannot know the
+# entry-start visible length (it depends on the state row at runtime); ops
+# never reference positions beyond the true length, so an infinite base
+# piece yields identical splits.
+BASE_INF = 1 << 40
+
+# Run-head kinds (how the head char anchors).
+K_OWN = 1        # right child of an own char (anchor_lv)
+K_LEFTJOIN = 2   # left child of an own char (anchor_lv); ol via query q
+K_ROOT = 3       # block root: anchors via query q, case decided on device
+
+
+class _P:
+    """Piece: `base >= 0` — snapshot chars [base, base+length) in
+    entry-start coords; `base == -1` — own chars [lv, lv+length) whose
+    governing run head is `head`."""
+
+    __slots__ = ("base", "lv", "length", "alive", "head", "prio", "left",
+                 "right", "up", "sub_alive")
+
+    def __init__(self, base: int, lv: int, length: int, alive: bool,
+                 prio: int, head: int = -1):
+        self.base = base
+        self.lv = lv
+        self.length = length
+        self.alive = alive
+        self.head = head
+        self.prio = prio
+        self.left: Optional[_P] = None
+        self.right: Optional[_P] = None
+        self.up: Optional[_P] = None
+        self.sub_alive = length if alive else 0
+
+    @property
+    def own_alive(self) -> int:
+        return self.length if self.alive else 0
+
+
+def _upd(n: _P) -> None:
+    s = n.own_alive
+    if n.left is not None:
+        s += n.left.sub_alive
+    if n.right is not None:
+        s += n.right.sub_alive
+    n.sub_alive = s
+
+
+def _fix_up(n: Optional[_P]) -> None:
+    while n is not None:
+        _upd(n)
+        n = n.up
+
+
+@dataclass
+class ComposedEntry:
+    """One entry's composition result (see module docstring). All own-char
+    references are LVs; the slot mapping is applied by the executor."""
+    # queries: cursor coords in entry-start-visible space
+    q_cursor: List[int] = field(default_factory=list)
+    # per own char, grouped by block in final (piece-table) order
+    ch_lv: np.ndarray = None          # int64 [nc]
+    ch_block: np.ndarray = None       # int32 [nc]
+    ch_head: np.ndarray = None        # int8  [nc] 1 = run head char
+    ch_kind: np.ndarray = None        # int8  [nc] K_* for heads, 0 interior
+    ch_anchor: np.ndarray = None      # int64 [nc] own anchor lv or -1
+    ch_q: np.ndarray = None           # int32 [nc] query idx or -1
+    ch_headlv: np.ndarray = None      # int64 [nc] governing run-head lv
+    ch_orrown: np.ndarray = None      # int64 [nc] own-char orr lv or -1 (=B)
+    # per block
+    blk_root_q: np.ndarray = None     # int32 [nb] root query idx
+    blk_root_lv: np.ndarray = None    # int64 [nb] root head char lv
+    blk_start: np.ndarray = None      # int32 [nb] first char idx in ch_*
+    blk_len: np.ndarray = None        # int32 [nb]
+    # deletes
+    del_base: List[Tuple[int, int]] = field(default_factory=list)  # coords
+    del_own: List[Tuple[int, int]] = field(default_factory=list)   # lv range
+
+    def num_chars(self) -> int:
+        return 0 if self.ch_lv is None else len(self.ch_lv)
+
+
+@dataclass
+class _HeadMeta:
+    kind: int
+    anchor_lv: int   # own char lv (K_OWN parent / K_LEFTJOIN parent)
+    q: int           # query idx (K_LEFTJOIN ol / K_ROOT), else -1
+    block: int       # block id the run belongs to
+    orr_own: int     # origin-right when it is an own char (next piece at
+                     # insert time was own): its lv; -1 = the block's B
+                     # (the snapshot-resolved origin-right — a run whose
+                     # right neighbor at insert time was the snapshot is
+                     # the block's current tail, so its origin-right IS
+                     # the root's device-resolved B; merge.rs:407-424)
+
+
+class EntryComposer:
+    """Piece-table composer for one entry's sequential op stream."""
+
+    def __init__(self) -> None:
+        self._next_prio = 0x9E3779B97F4A7C15
+        self.root: Optional[_P] = _P(0, -1, BASE_INF, True, self._prio())
+        self.q_cursor: List[int] = []
+        self.heads: Dict[int, _HeadMeta] = {}   # run-head lv -> meta
+        self.n_blocks = 0
+        self.blk_root_lv: List[int] = []        # block id -> root head lv
+        self.del_base: List[Tuple[int, int]] = []
+        self.del_own: List[Tuple[int, int]] = []
+
+    def _prio(self) -> int:
+        # splitmix64: deterministic, well-mixed treap priorities
+        self._next_prio = (self._next_prio + 0x9E3779B97F4A7C15) & ((1 << 64) - 1)
+        z = self._next_prio
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & ((1 << 64) - 1)
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & ((1 << 64) - 1)
+        return z ^ (z >> 31)
+
+    # ---- treap machinery -------------------------------------------------
+
+    def _rot_up(self, x: _P) -> None:
+        p = x.up
+        g = p.up
+        if p.left is x:
+            p.left = x.right
+            if p.left is not None:
+                p.left.up = p
+            x.right = p
+        else:
+            p.right = x.left
+            if p.right is not None:
+                p.right.up = p
+            x.left = p
+        p.up = x
+        x.up = g
+        if g is not None:
+            if g.left is p:
+                g.left = x
+            else:
+                g.right = x
+        else:
+            self.root = x
+        _upd(p)
+        _upd(x)
+
+    def _bubble(self, x: _P) -> None:
+        while x.up is not None and x.up.prio < x.prio:
+            self._rot_up(x)
+        if x.up is None:
+            self.root = x
+        else:
+            _fix_up(x.up)
+
+    def _insert_after(self, a: Optional[_P], x: _P) -> None:
+        """Insert piece x immediately after piece a (a=None → first)."""
+        if a is None:
+            n = self.root
+            if n is None:
+                self.root = x
+                return
+            while n.left is not None:
+                n = n.left
+            n.left = x
+            x.up = n
+        elif a.right is None:
+            a.right = x
+            x.up = a
+        else:
+            n = a.right
+            while n.left is not None:
+                n = n.left
+            n.left = x
+            x.up = n
+        _fix_up(x.up)
+        self._bubble(x)
+
+    @staticmethod
+    def _succ(n: _P) -> Optional[_P]:
+        if n.right is not None:
+            n = n.right
+            while n.left is not None:
+                n = n.left
+            return n
+        while n.up is not None and n.up.right is n:
+            n = n.up
+        return n.up
+
+    def _find_visible(self, pos: int) -> Tuple[_P, int]:
+        """(piece, offset) of visible char `pos` (0-indexed)."""
+        n = self.root
+        while n is not None:
+            la = n.left.sub_alive if n.left is not None else 0
+            if pos < la:
+                n = n.left
+            elif n.alive and pos < la + n.length:
+                return n, pos - la
+            else:
+                pos -= la + n.own_alive
+                n = n.right
+        raise IndexError("visible position out of range")
+
+    def _split(self, n: _P, off: int) -> _P:
+        """Split piece at char offset (0 < off < length); returns the
+        right half (inserted immediately after n)."""
+        assert 0 < off < n.length
+        if n.base >= 0:
+            right = _P(n.base + off, -1, n.length - off, n.alive,
+                       self._prio())
+        else:
+            right = _P(-1, n.lv + off, n.length - off, n.alive,
+                       self._prio(), head=n.head)
+        n.length = off
+        _fix_up(n)
+        self._insert_after(n, right)
+        return right
+
+    # ---- ops -------------------------------------------------------------
+
+    def insert(self, pos: int, lv: int, length: int) -> None:
+        if pos == 0:
+            prev = None
+        else:
+            node, off = self._find_visible(pos - 1)
+            if off + 1 < node.length:
+                self._split(node, off + 1)
+            prev = node
+        nxt = self._succ(prev) if prev is not None else self._leftmost()
+
+        orr_own = nxt.lv if (nxt is not None and nxt.base < 0) else -1
+        if prev is not None and prev.base < 0:
+            # ol is an own char: right child of it (K_OWN)
+            anchor = prev.lv + prev.length - 1
+            meta = _HeadMeta(K_OWN, anchor, -1, self.heads[prev.head].block,
+                             orr_own)
+        elif nxt is not None and nxt.base < 0:
+            # ol snapshot/doc-start, next piece own: left-join that block
+            q = self._emit_query(prev)
+            meta = _HeadMeta(K_LEFTJOIN, nxt.lv, q,
+                             self.heads[nxt.head].block, orr_own)
+        else:
+            # new block root
+            q = self._emit_query(prev)
+            blk = self.n_blocks
+            self.n_blocks += 1
+            self.blk_root_lv.append(lv)
+            meta = _HeadMeta(K_ROOT, -1, q, blk, -1)
+        self.heads[lv] = meta
+        new = _P(-1, lv, length, True, self._prio(), head=lv)
+        self._insert_after(prev, new)
+
+    def _emit_query(self, prev: Optional[_P]) -> int:
+        """Query for the snapshot gap after `prev` (a snapshot piece or
+        None = doc start). Cursor coord = snapshot chars before the gap."""
+        assert prev is None or prev.base >= 0, "query gap must be snapshot"
+        c = 0 if prev is None else prev.base + prev.length
+        self.q_cursor.append(c)
+        return len(self.q_cursor) - 1
+
+    def _leftmost(self) -> Optional[_P]:
+        n = self.root
+        if n is None:
+            return None
+        while n.left is not None:
+            n = n.left
+        return n
+
+    def delete(self, pos: int, length: int) -> None:
+        node, off = self._find_visible(pos)
+        if off > 0:
+            node = self._split(node, off)
+        remaining = length
+        while remaining > 0:
+            assert node is not None, "delete past end of document"
+            if not node.alive:
+                node = self._succ(node)
+                continue
+            take = min(remaining, node.length)
+            if take < node.length:
+                self._split(node, take)
+            if node.base >= 0:
+                self.del_base.append((node.base, node.base + take))
+            else:
+                self.del_own.append((node.lv, node.lv + take))
+            node.alive = False
+            _fix_up(node)
+            remaining -= take
+            node = self._succ(node)
+
+    # ---- result ----------------------------------------------------------
+
+    def _in_order(self) -> List[_P]:
+        out: List[_P] = []
+        st: List[_P] = []
+        cur = self.root
+        while st or cur is not None:
+            while cur is not None:
+                st.append(cur)
+                cur = cur.left
+            cur = st.pop()
+            out.append(cur)
+            cur = cur.right
+        return out
+
+    def finish(self) -> ComposedEntry:
+        out = ComposedEntry()
+        out.q_cursor = self.q_cursor
+        out.del_base = self.del_base
+        out.del_own = self.del_own
+
+        # walk the table in order, collecting own chars grouped by their
+        # block ids; intra-block order IS table order
+        per_block: Dict[int, List[Tuple[int, int]]] = {}
+        for p in self._in_order():
+            if p.base >= 0:
+                continue
+            blk = self.heads[p.head].block
+            lst = per_block.setdefault(blk, [])
+            lst.extend((lv, p.head) for lv in range(p.lv, p.lv + p.length))
+
+        ch_lv: List[int] = []
+        ch_block: List[int] = []
+        ch_head: List[int] = []
+        ch_kind: List[int] = []
+        ch_anchor: List[int] = []
+        ch_q: List[int] = []
+        ch_headlv: List[int] = []
+        ch_orrown: List[int] = []
+        blk_start: List[int] = []
+        blk_len: List[int] = []
+        blk_root_q: List[int] = []
+        blk_root_lv: List[int] = []
+        for blk in sorted(per_block):
+            lvs = per_block[blk]
+            blk_start.append(len(ch_lv))
+            blk_len.append(len(lvs))
+            root_lv = self.blk_root_lv[blk]
+            blk_root_q.append(self.heads[root_lv].q)
+            blk_root_lv.append(root_lv)
+            bi = len(blk_start) - 1
+            for lv, head_lv in lvs:
+                meta = self.heads.get(lv) if lv == head_lv else None
+                head_meta = self.heads[head_lv]
+                ch_lv.append(lv)
+                ch_block.append(bi)
+                ch_headlv.append(head_lv)
+                ch_orrown.append(head_meta.orr_own)
+                if meta is not None:
+                    ch_head.append(1)
+                    ch_kind.append(meta.kind)
+                    ch_anchor.append(meta.anchor_lv)
+                    ch_q.append(meta.q)
+                else:
+                    ch_head.append(0)
+                    ch_kind.append(0)
+                    ch_anchor.append(-1)
+                    ch_q.append(-1)
+
+        out.ch_lv = np.asarray(ch_lv, dtype=np.int64)
+        out.ch_block = np.asarray(ch_block, dtype=np.int32)
+        out.ch_head = np.asarray(ch_head, dtype=np.int8)
+        out.ch_kind = np.asarray(ch_kind, dtype=np.int8)
+        out.ch_anchor = np.asarray(ch_anchor, dtype=np.int64)
+        out.ch_q = np.asarray(ch_q, dtype=np.int32)
+        out.ch_headlv = np.asarray(ch_headlv, dtype=np.int64)
+        out.ch_orrown = np.asarray(ch_orrown, dtype=np.int64)
+        out.blk_root_q = np.asarray(blk_root_q, dtype=np.int32)
+        out.blk_root_lv = np.asarray(blk_root_lv, dtype=np.int64)
+        out.blk_start = np.asarray(blk_start, dtype=np.int32)
+        out.blk_len = np.asarray(blk_len, dtype=np.int32)
+        return out
+
+
+def compose_entry(oplog, span: Tuple[int, int]) -> ComposedEntry:
+    """Compose one entry's op stream into entry-start coordinates."""
+    comp = EntryComposer()
+    for piece in oplog.ops.iter_range(span):
+        if piece.kind == INS:
+            assert piece.fwd, "reverse insert runs are unimplemented " \
+                "(matches reference merge.rs:384 unimplemented!)"
+            comp.insert(piece.start, piece.lv, len(piece))
+        else:
+            comp.delete(piece.start, len(piece))
+    return comp.finish()
+
+
+def compose_plan(oplog, plan) -> List[ComposedEntry]:
+    """Compose every entry of a fork/join plan (host control-flow pass)."""
+    return [compose_entry(oplog, en.span) for en in plan.entries]
+
+
+def assemble_prefix(oplog, ff_spans) -> str:
+    """Replay the linear fast-forward prefix WITHOUT any merge engine: the
+    spans are causally linear (plan2's ff extraction), so one piece-table
+    composition over an empty base reconstructs the text directly from the
+    insert arena (reference equivalent: the FF-mode streaming of
+    merge.rs:792-859, minus the tracker)."""
+    comp = EntryComposer()
+    comp.root = None   # no snapshot: the prefix starts from nothing
+    for (s, e) in sorted(ff_spans):
+        for piece in oplog.ops.iter_range((s, e)):
+            if piece.kind == INS:
+                comp.insert(piece.start, piece.lv, len(piece))
+            else:
+                comp.delete(piece.start, len(piece))
+    parts: List[str] = []
+    for p in comp._in_order():
+        if p.base < 0 and p.alive:
+            s = oplog.ops.content_slice(p.lv, p.length)
+            assert s is not None, "insert content missing from arena"
+            parts.append(s)
+    return "".join(parts)

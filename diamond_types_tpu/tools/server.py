@@ -73,6 +73,7 @@ class DocStore:
         self.docs: Dict[str, OpLog] = {}
         self.dirty: Dict[str, float] = {}
         self.lock = threading.Lock()
+        self.io_lock = threading.Lock()   # serializes flush passes
         # Long-poll wakeups (one condition per doc; notified on new ops).
         self._conds: Dict[str, threading.Condition] = {}
         self._stop = threading.Event()
@@ -141,24 +142,29 @@ class DocStore:
             return
         os.makedirs(self.data_dir, exist_ok=True)
         now = time.monotonic()
-        # Encode UNDER the lock (/push and /edit mutate oplogs under it; an
-        # encode racing a mutation could crash or persist a torn snapshot);
-        # only the disk write happens outside it.
-        blobs = []
-        with self.lock:
-            due = [d for d, t in self.dirty.items()
-                   if force or now - t >= self.save_interval]
-            for d in due:
-                del self.dirty[d]
-                ol = self.docs.get(d)
-                if ol is not None:
-                    blobs.append((d, encode_oplog(ol, ENCODE_FULL)))
-        for doc_id, blob in blobs:
-            path = self._path(doc_id)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)  # atomic
+        # io_lock serializes whole flush passes: without it, a flusher
+        # stalled mid-write could overwrite a NEWER snapshot written by a
+        # concurrent flush(force=True) (e.g. server_close) with its stale
+        # blob after the dirty flag was already cleared.
+        with self.io_lock:
+            # Encode UNDER the store lock (/push and /edit mutate oplogs
+            # under it; an encode racing a mutation could crash or persist
+            # a torn snapshot); only the disk write happens outside it.
+            blobs = []
+            with self.lock:
+                due = [d for d, t in self.dirty.items()
+                       if force or now - t >= self.save_interval]
+                for d in due:
+                    del self.dirty[d]
+                    ol = self.docs.get(d)
+                    if ol is not None:
+                        blobs.append((d, encode_oplog(ol, ENCODE_FULL)))
+            for doc_id, blob in blobs:
+                path = self._path(doc_id)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)  # atomic
 
 
 class SyncHandler(BaseHTTPRequestHandler):
@@ -233,7 +239,8 @@ class SyncHandler(BaseHTTPRequestHandler):
         from ..encoding.decode import ParseError
         try:
             self._do_post()
-        except (ValueError, KeyError, TypeError, ParseError) as e:
+        except (ValueError, KeyError, TypeError, AttributeError,
+                ParseError) as e:
             try:
                 self._send(400, json.dumps(
                     {"error": f"bad request: {e.__class__.__name__}"})

@@ -1,0 +1,136 @@
+"""Zone engine (compose + per-entry YjsMod resolution) — the merge path
+with NO native/M1 transform anywhere: host does plan compilation + entry
+composition only; origin resolution happens against state rows exactly the
+way tpu/zone_kernel.py runs it on device. Differential-tested here against
+the tracker engines (reference test strategy: cross-engine differential
+testing, SURVEY.md §4.6).
+"""
+
+import os
+import random
+
+import pytest
+
+from diamond_types_tpu import OpLog
+from diamond_types_tpu.listmerge.zone_np import zone_checkout_np
+
+BENCH_DATA = "/root/reference/benchmark_data"
+ALPHABET = "abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|"
+
+
+def random_edit(rng, oplog, agent, version, content):
+    doc_len = len(content)
+    insert_weight = 0.65 if doc_len < 100 else 0.45
+    if doc_len == 0 or rng.random() < insert_weight:
+        pos = rng.randint(0, doc_len)
+        n = rng.randint(1, 4)
+        s = "".join(rng.choice(ALPHABET) for _ in range(n))
+        lv = oplog.add_insert_at(agent, version, pos, s)
+        content = content[:pos] + s + content[pos:]
+    else:
+        start = rng.randint(0, doc_len - 1)
+        n = min(rng.randint(1, 5), doc_len - start)
+        lv = oplog.add_delete_at(agent, version, start, start + n,
+                                 content[start:start + n])
+        content = content[:start] + content[start + n:]
+    return [lv], content
+
+
+@pytest.mark.parametrize(
+    "corpus", ["friendsforever.dt", "git-makefile.dt", "node_nodecc.dt"])
+def test_zone_corpus_parity(corpus):
+    """Byte parity with the tracker engine on every shipped corpus —
+    including git-makefile's same-agent-on-concurrent-branches DAG."""
+    from diamond_types_tpu.encoding.decode import load_oplog
+    with open(os.path.join(BENCH_DATA, corpus), "rb") as f:
+        ol = load_oplog(f.read())
+    txt, frontier = zone_checkout_np(ol)
+    b = ol.checkout_tip()
+    assert txt == b.snapshot()
+    assert sorted(frontier) == sorted(b.version)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_zone_concurrent_branches(seed):
+    """Random concurrent branches in one oplog; zone checkout must equal
+    the tracker checkout."""
+    rng = random.Random(7000 + seed)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n)
+              for n in ("alice", "bob", "carol")]
+    branches = [([], "")]
+    for _ in range(60):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        agent = agents[rng.randrange(len(agents))]
+        version, content = random_edit(rng, ol, agent, version, content)
+        if rng.random() < 0.25 and len(branches) < 5:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    txt, _ = zone_checkout_np(ol)
+    assert txt == ol.checkout_tip().snapshot()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_zone_same_agent_concurrent(seed):
+    """The git-import pattern: ONE agent committing on parallel branches
+    (sequence numbers out of causal order). This is the regression class
+    behind round-3's first zone-engine bug."""
+    rng = random.Random(9100 + seed)
+    ol = OpLog()
+    agent = ol.get_or_create_agent_id("git-author")
+    other = ol.get_or_create_agent_id("other")
+    branches = [([], "")]
+    for _ in range(50):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        a = agent if rng.random() < 0.7 else other
+        version, content = random_edit(rng, ol, a, version, content)
+        if rng.random() < 0.3 and len(branches) < 6:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    txt, _ = zone_checkout_np(ol)
+    assert txt == ol.checkout_tip().snapshot()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_zone_incremental_merge(seed):
+    """zone_checkout_np(from, merge) must equal the tracker's Branch.merge
+    result from the same frontier (the incremental hot path,
+    reference: src/list/merge.rs:63-96)."""
+    rng = random.Random(9900 + seed)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("a", "b")]
+    branches = [([], "")]
+    versions_seen = []
+    for _ in range(50):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        agent = agents[rng.randrange(2)]
+        version, content = random_edit(rng, ol, agent, version, content)
+        versions_seen.append(list(version))
+        if rng.random() < 0.25 and len(branches) < 4:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    frm = versions_seen[rng.randrange(len(versions_seen))]
+    # oracle: checkout at `frm`, then merge to tip via the tracker engine
+    b = ol.checkout(frm)
+    b.merge_tip(ol)
+    txt, frontier = zone_checkout_np(ol, frm)
+    assert txt == b.snapshot()
+    assert sorted(frontier) == sorted(b.version)
+
+
+def test_zone_empty_and_linear():
+    ol = OpLog()
+    assert zone_checkout_np(ol)[0] == ""
+    a = ol.get_or_create_agent_id("x")
+    v = [ol.add_insert_at(a, [], 0, "hello ")]
+    v = [ol.add_insert_at(a, v, 6, "world")]
+    v = [ol.add_delete_at(a, v, 0, 1, "h")]
+    txt, fr = zone_checkout_np(ol)
+    assert txt == "ello world"
+    assert sorted(fr) == sorted(ol.version)
