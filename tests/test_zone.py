@@ -11,10 +11,12 @@ import random
 
 import pytest
 
+from conftest import reference_path
+
 from diamond_types_tpu import OpLog
 from diamond_types_tpu.listmerge.zone_np import zone_checkout_np
 
-BENCH_DATA = "/root/reference/benchmark_data"
+BENCH_DATA = reference_path("benchmark_data")
 ALPHABET = "abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|"
 
 

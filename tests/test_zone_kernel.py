@@ -1,0 +1,95 @@
+"""Device zone kernel (tpu/zone_kernel.py) — differential tests against
+the NumPy reference executor and the tracker engines. Runs on the CPU
+backend (conftest pins JAX_PLATFORMS=cpu for tests); the same jitted scan
+is what the bench executes on the chip.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from diamond_types_tpu import OpLog
+from diamond_types_tpu.tpu.zone_kernel import (pack_zone_tape,
+                                               zone_checkout_device)
+from diamond_types_tpu.listmerge.zone_np import prepare_zone
+
+from conftest import reference_path
+from test_zone import random_edit
+
+BENCH_DATA = reference_path("benchmark_data")
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_zone_kernel_fuzz(seed):
+    """Random concurrent branches; the device scan must match the tracker
+    checkout byte for byte."""
+    rng = random.Random(5300 + seed)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("alice", "bob", "git")]
+    branches = [([], "")]
+    for _ in range(40):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        # same-agent-on-parallel-branches included (agent picked freely)
+        agent = agents[rng.randrange(len(agents))]
+        version, content = random_edit(rng, ol, agent, version, content)
+        if rng.random() < 0.3 and len(branches) < 5:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    txt, fr = zone_checkout_device(ol)
+    b = ol.checkout_tip()
+    assert txt == b.snapshot()
+    assert sorted(fr) == sorted(b.version)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_zone_kernel_tiny_budgets(seed):
+    """Force sub-step splitting (continuation blocks, delete spill) with
+    tiny budgets; the packing must not change the result."""
+    rng = random.Random(6400 + seed)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("a", "b")]
+    branches = [([], "")]
+    for _ in range(30):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        version, content = random_edit(rng, ol, agents[rng.randrange(2)],
+                                       version, content)
+        if rng.random() < 0.35 and len(branches) < 4:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    prep = prepare_zone(ol)
+    if not prep.plan.entries:
+        return
+    tape = pack_zone_tape(prep, max_blocks=2, max_chars=4, max_dels=1)
+    txt, _ = zone_checkout_device(ol, prep=prep, tape=tape)
+    assert txt == ol.checkout_tip().snapshot()
+
+
+def test_zone_kernel_friendsforever():
+    """Real-corpus parity through the jitted scan (two-agent realtime
+    trace; the other corpora run under DT_ZONE_KERNEL_BIG=1 — minutes on
+    the CPU backend — and in the bench on the chip)."""
+    from diamond_types_tpu.encoding.decode import load_oplog
+    with open(os.path.join(BENCH_DATA, "friendsforever.dt"), "rb") as f:
+        ol = load_oplog(f.read())
+    txt, fr = zone_checkout_device(ol)
+    b = ol.checkout_tip()
+    assert txt == b.snapshot()
+    assert sorted(fr) == sorted(b.version)
+
+
+@pytest.mark.skipif(not os.environ.get("DT_ZONE_KERNEL_BIG"),
+                    reason="minutes on the CPU backend; bench covers it "
+                           "on the chip (DT_ZONE_KERNEL_BIG=1 to force)")
+@pytest.mark.parametrize("corpus", ["git-makefile.dt", "node_nodecc.dt"])
+def test_zone_kernel_big_corpora(corpus):
+    from diamond_types_tpu.encoding.decode import load_oplog
+    with open(os.path.join(BENCH_DATA, corpus), "rb") as f:
+        ol = load_oplog(f.read())
+    txt, _ = zone_checkout_device(ol)
+    assert txt == ol.checkout_tip().snapshot()
