@@ -124,6 +124,11 @@ class Tracker:
         self._ins_nodes = {under.ids: under}
         # Delete-op LV -> target items: rows (lv0, lv1, t0, t1, fwd), disjoint.
         self._del_rows: List[Tuple[int, int, int, int, bool]] = []
+        # Genuinely colliding concurrent inserts seen by integrate
+        # (reference: merge_conflict_checks, listmerge/mod.rs:50-51 —
+        # set whenever the scan meets another item that is not simply our
+        # origin-right).
+        self.collisions = 0
 
     # ---- treap plumbing --------------------------------------------------
 
@@ -322,6 +327,7 @@ class Tracker:
             other_lv = other.ids + off
             if other_lv == item.orr:
                 break
+            self.collisions += 1   # a genuinely concurrent insert here
 
             # Only not-yet-inserted items can be concurrent with us here.
             assert other.state == NOT_INSERTED_YET

@@ -56,6 +56,13 @@ class TransformedOps:
     def __iter__(self) -> Iterator[XfOp]:
         return self._gen()
 
+    @property
+    def collisions(self) -> int:
+        """Colliding concurrent inserts seen while transforming (valid
+        after the iterator is exhausted; reference: merge_conflict_checks
+        flag, listmerge/mod.rs:50-51)."""
+        return self.tracker.collisions if self.tracker is not None else 0
+
     def _gen(self) -> Iterator[XfOp]:
         graph, aa, ops = self.graph, self.aa, self.ops
 

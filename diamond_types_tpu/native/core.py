@@ -82,6 +82,8 @@ def _load():
         np.ctypeslib.ndpointer(np.int64, flags="C"),
         np.ctypeslib.ndpointer(np.uint8, flags="C")]
     lib.dt_dump_del_rows.restype = ct.c_int64
+    lib.dt_last_collisions.argtypes = [ct.c_void_p]
+    lib.dt_last_collisions.restype = ct.c_int64
     lib.dt_decode_new.argtypes = [
         np.ctypeslib.ndpointer(np.uint8, flags="C"), ct.c_int64]
     lib.dt_decode_new.restype = ct.c_void_p
@@ -237,6 +239,11 @@ class NativeContext:
     def release_tracker(self) -> None:
         """Free the tracker tables retained for dump_tracker/zone_common."""
         self._lib.dt_release_tracker(self._ptr)
+
+    def last_collisions(self) -> int:
+        """Colliding concurrent inserts during the last transform
+        (reference: has_conflicts_when_merging, src/list/merge.rs:51)."""
+        return int(self._lib.dt_last_collisions(self._ptr))
 
     def zone_common(self):
         """Common-ancestor frontier of the last transform's conflict zone

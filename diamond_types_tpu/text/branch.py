@@ -7,7 +7,7 @@ src/list/branch.rs, src/list/merge.rs:63-96).
 from __future__ import annotations
 
 import os
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from ..utils.rope import Rope
 from .op import DEL, INS
@@ -15,11 +15,16 @@ from .oplog import OpLog
 
 
 class Branch:
-    __slots__ = ("version", "content")
+    __slots__ = ("version", "content", "last_merge_collisions")
 
     def __init__(self) -> None:
         self.version: List[int] = []
         self.content = Rope()
+        # collisions reported by the last merge() — genuinely concurrent
+        # inserts at the same gap (reference: has_conflicts_when_merging,
+        # src/list/merge.rs:51). None = the selected engine doesn't report
+        # (plan2/device tiers); 0 = merged cleanly.
+        self.last_merge_collisions: Optional[int] = None
 
     def __len__(self) -> int:
         return len(self.content)
@@ -83,6 +88,7 @@ class Branch:
             listmerge2 design; listmerge/plan2.py + dense.py),
           * DT_TPU_NO_NATIVE=1 — pure-Python engine (the oracle).
         """
+        self.last_merge_collisions = None
         if os.environ.get("DT_TPU_PLAN2"):
             from ..listmerge.dense import merge_via_plan2
             rows, final = merge_via_plan2(oplog, self.version,
@@ -100,15 +106,19 @@ class Branch:
         if not os.environ.get("DT_TPU_NO_NATIVE"):
             from ..native import merge_native, native_available
             if native_available():
+                from ..native.core import get_native_ctx
                 doc, frontier = merge_native(oplog, self.snapshot(),
                                              self.version, merge_frontier)
                 self.content = Rope(doc)
                 self.version = frontier
+                self.last_merge_collisions = \
+                    get_native_ctx(oplog).last_collisions()
                 return
 
         xf = oplog.get_xf_operations_full(self.version, merge_frontier)
         self._apply_xf(oplog, xf)
         self.version = list(xf.next_frontier)
+        self.last_merge_collisions = xf.collisions
 
     def _apply_xf(self, oplog: OpLog, rows) -> None:
         """Apply an (lv, op, xf_pos|None) stream to this branch's content —

@@ -8,6 +8,7 @@ local/remote append paths, checkout, transformed-op iteration, stats.
 
 from __future__ import annotations
 
+import os
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 from ..causalgraph.causal_graph import CausalGraph
@@ -210,6 +211,45 @@ class OpLog:
 
     def iter_xf_operations(self):
         return self.iter_xf_operations_from([], self.version)
+
+    # --- conflict detection --------------------------------------------------
+
+    def count_conflicts_when_merging(
+            self, from_frontier: Sequence[int],
+            merge_frontier: Optional[Sequence[int]] = None) -> int:
+        """How many genuinely colliding concurrent inserts the merge from
+        `from_frontier` to `merge_frontier` (default: tip) resolves —
+        concurrent inserts landing in the same document gap, the YjsMod
+        tie-break actually firing. 0 means the merge is trivial: positions
+        transform cleanly with no insert-order ambiguity. The exact count
+        is engine-granularity-specific (RLE runs, not chars); only
+        zero-vs-nonzero is engine-independent — the reference likewise
+        keeps only a boolean flag.
+
+        Reference: `has_conflicts_when_merging` (src/list/merge.rs:51) and
+        the merge_conflict_checks collision flag (listmerge/mod.rs:50-51,
+        merge.rs:176-179)."""
+        merge = list(self.version) if merge_frontier is None \
+            else list(merge_frontier)
+        frm = [int(x) for x in from_frontier]
+        if not os.environ.get("DT_TPU_NO_NATIVE"):
+            from ..native import native_available
+            if native_available():
+                from ..native.core import get_native_ctx
+                ctx = get_native_ctx(self)
+                ctx.transform(frm, merge)
+                ctx.release_tracker()
+                return ctx.last_collisions()
+        xf = self.get_xf_operations_full(frm, merge)
+        for _ in xf:
+            pass
+        return xf.collisions
+
+    def has_conflicts_when_merging(
+            self, from_frontier: Sequence[int],
+            merge_frontier: Optional[Sequence[int]] = None) -> bool:
+        return self.count_conflicts_when_merging(
+            from_frontier, merge_frontier) > 0
 
     # --- checkout ----------------------------------------------------------
 

@@ -13,7 +13,11 @@ long-polls as the braid-subscription equivalent):
   GET  /doc/{id}/summary    -> version summary JSON
   POST /doc/{id}/pull       body: client's summary JSON
                             -> binary patch from the common version
-  POST /doc/{id}/push       body: binary patch -> {"ok": true}
+  POST /doc/{id}/push       body: binary patch -> {"ok": true,
+                            "collisions": n | null} — n > 0 when folding
+                            the pushed ops into the pre-push document
+                            resolved genuinely colliding concurrent
+                            inserts (has_conflicts_when_merging)
 
 Browser tier (the reference's "dumb client" OT mode — README.md:31-33;
 clients are positional, the server's CRDT does the merging; see
@@ -36,6 +40,10 @@ web_assets.py for the pages):
                             patches to subscribed clients)
   GET  /doc/{id}/graph      -> causal DAG runs JSON (visualizer data)
   POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
+  POST /doc/{id}/history    body {"n": k} -> {"snapshots": [{"lv",
+                            "text"}...]} oldest-first history strip; with
+                            DT_SERVER_DEVICE=1 the whole strip is ONE
+                            batched device call (texts_at_versions)
 
 Run: python -m diamond_types_tpu.tools.server --port 8008 --data-dir docs/
 """
@@ -167,6 +175,57 @@ class DocStore:
                 os.replace(tmp, path)  # atomic
 
 
+def doc_history_strip(ol: OpLog, n: int, tip: Optional[list] = None):
+    """Up to `n` historical snapshots of `ol` up to the frozen frontier
+    `tip`, oldest-first, as [{"lv", "text"}].
+
+    With DT_SERVER_DEVICE=1 and a conflict zone present, the whole strip
+    is materialized by ONE vmapped device call (tpu/plan_kernels.py
+    texts_at_versions — the reference can only checkout one version per
+    tracker rebuild, src/list/oplog.rs:32). The default path samples host
+    checkouts instead: this process serves HTTP, and first-touch JAX
+    backend init against a wedged accelerator tunnel would hang the
+    handler (the bench isolates device work in watchdogged subprocesses;
+    a server cannot)."""
+    if len(ol) == 0:
+        return []
+    tip = list(ol.version) if tip is None else list(tip)
+    from ..listmerge.plan2 import compile_plan2
+    plan = compile_plan2(ol.cg.graph, [], tip)
+    out = []
+    n_entries = len(plan.entries)
+    if n_entries and os.environ.get("DT_SERVER_DEVICE"):
+        from ..native import native_available
+        from ..tpu.plan_kernels import texts_at_versions
+        take = min(max(n - 1, 1), n_entries)
+        idxs = [round(i * (n_entries - 1) / max(take - 1, 1))
+                for i in range(take)]
+        idxs = sorted(set(idxs))
+        source = "native" if native_available() and \
+            not os.environ.get("DT_TPU_NO_NATIVE") else "python"
+        texts = texts_at_versions(ol, idxs, merge_frontier=tip,
+                                  source=source)
+        for k, txt in zip(idxs, texts):
+            out.append({"lv": int(plan.entries[k].span[1]) - 1,
+                        "text": txt})
+        # an entry's snapshot is its own causal cone; the strip's last
+        # stop is the MERGED tip (all cones joined)
+        out.append({"lv": int(max(t for t in tip)),
+                    "text": ol.checkout(tip).snapshot()})
+        return out
+    # host path: sample versions along the LV axis (each checkout is a
+    # fast native merge)
+    top = max(tip) + 1
+    take = min(n, top)
+    lvs = sorted({round((i + 1) * top / take) - 1 for i in range(take)})
+    for lv in lvs:
+        f = ol.cg.graph.find_dominators([lv])
+        out.append({"lv": int(lv), "text": ol.checkout(f).snapshot()})
+    if out and out[-1]["lv"] == top - 1 and len(tip) > 1:
+        out[-1] = {"lv": top - 1, "text": ol.checkout(tip).snapshot()}
+    return out
+
+
 class SyncHandler(BaseHTTPRequestHandler):
     store: DocStore = None  # class attr, set by serve()
 
@@ -263,10 +322,20 @@ class SyncHandler(BaseHTTPRequestHandler):
             return self._send(200, patch, "application/octet-stream")
         if action == "push":
             with self.store.lock:
+                pre = list(ol.version)
                 decode_into(ol, body)
+                # Does folding the pushed ops into the pre-push document
+                # actually collide (concurrent inserts at one gap)?
+                # Surfaced so clients can flag ambiguous merges
+                # (reference: has_conflicts_when_merging, merge.rs:51).
+                try:
+                    collisions = ol.count_conflicts_when_merging(pre)
+                except Exception:
+                    collisions = None
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
-            return self._send(200, b'{"ok": true}')
+            return self._send(200, json.dumps(
+                {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
             req = json.loads(body)
             # Normalize each op ONCE (ints coerced exactly once, via
@@ -345,6 +414,27 @@ class SyncHandler(BaseHTTPRequestHandler):
                         return self._send(200,
                                           json.dumps(out).encode("utf8"))
                     c.wait(timeout=min(remaining, 5.0))
+        if action == "history":
+            # Batched time travel: ONE vmapped device call materializes
+            # every requested historical snapshot (tpu/plan_kernels.py
+            # texts_at_versions — a visibility mask per version over one
+            # shared linearization). The reference can only checkout one
+            # version at a time, rebuilding a tracker per call
+            # (src/list/oplog.rs:32). This powers the visualizer's
+            # history strip as a product feature, not a test-only demo.
+            from operator import index as _ix
+            req = json.loads(body or b"{}")
+            n = min(max(_ix(req.get("n", 16)), 1), 64)
+            # Freeze the frontier under the lock; compute OUTSIDE it.
+            # The oplog is append-only, so everything at or below the
+            # frozen frontier is immutable (readers slice runs by LV
+            # range) — and a slow/hung strip computation must not hold
+            # the store lock every other endpoint shares.
+            with self.store.lock:
+                tip = list(ol.version)
+            snaps = doc_history_strip(ol, n, tip)
+            return self._send(200, json.dumps({"snapshots": snaps})
+                              .encode("utf8"))
         if action == "at":
             from operator import index as _ix
             req = json.loads(body)

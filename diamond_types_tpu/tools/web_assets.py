@@ -162,10 +162,37 @@ VIS_HTML = """<!doctype html>
 <div id="wrap">
  <svg id="g" width="640" height="200"></svg>
  <div id="side"><em>click a run to time-travel to that version</em>
+  <div id="strip" style="margin:.6em 0">
+   <button id="loadStrip" type="button">load history strip</button>
+   <input id="scrub" type="range" min="0" max="0" value="0"
+    style="display:none;width:100%">
+   <span id="stripLabel"></span>
+  </div>
   <pre id="txt"></pre></div>
 </div>
 <script>
 const DOC = "__DOC__";
+// History strip: ONE request -> the server materializes every snapshot
+// in a single batched device call (texts_at_versions); scrubbing is then
+// instant and offline.
+let STRIP = null;
+document.getElementById("loadStrip").addEventListener("click", async () => {
+  const r = await fetch(`/doc/${DOC}/history`, {
+    method: "POST", body: JSON.stringify({n: 24})});
+  STRIP = (await r.json()).snapshots;
+  const s = document.getElementById("scrub");
+  s.max = STRIP.length - 1; s.value = STRIP.length - 1;
+  s.style.display = "block";
+  showStrip(STRIP.length - 1);
+});
+document.getElementById("scrub").addEventListener("input",
+  e => showStrip(+e.target.value));
+function showStrip(i){
+  if (!STRIP || !STRIP[i]) return;
+  document.getElementById("stripLabel").textContent =
+    `version ${STRIP[i].lv} (${i + 1}/${STRIP.length})`;
+  document.getElementById("txt").textContent = STRIP[i].text;
+}
 const NS = "http://www.w3.org/2000/svg";
 fetch(`/doc/${DOC}/graph`).then(r => r.json()).then(g => {
   const svg = document.getElementById("g");

@@ -375,7 +375,9 @@ def entry_frontier(graph, plan: MergePlan2, k: int) -> List[int]:
 
 def texts_at_versions(oplog, entries: Sequence[int],
                       from_frontier: Sequence[int] = (),
-                      source: str = "python") -> List[str]:
+                      source: str = "python",
+                      merge_frontier: Optional[Sequence[int]] = None
+                      ) -> List[str]:
     """Materialize the document at many historical versions (one per
     snapshot entry) in a single vmapped device call.
 
@@ -392,6 +394,7 @@ def texts_at_versions(oplog, entries: Sequence[int],
     from .merge_kernel import _arena_offsets
 
     plan, ex, tape, rows = snapshot_rows(oplog, from_frontier,
+                                         merge_frontier=merge_frontier,
                                          entries=entries, source=source)
     base_text = oplog.checkout(plan.common).snapshot()
     plen = len(base_text)

@@ -764,6 +764,10 @@ struct Tracker {
   // reference's marker-tree DelTarget entries (src/listmerge/markers.rs)
   std::vector<DelRow> del_list;
   std::vector<int32_t> del_run_of;  // op lv -> del_list index, -1 = none
+  // Genuinely colliding concurrent inserts seen by integrate (reference:
+  // merge_conflict_checks, listmerge/mod.rs:50-51 — counted whenever the
+  // scan meets another item that is not simply our origin-right).
+  i64 collisions = 0;
 
   // Forward-delete continuation memo: a long delete run is applied in
   // entry-bounded chunks with an unchanged current position (the text
@@ -1218,6 +1222,7 @@ struct Tracker {
       i64 off = cursor.off;
       i64 other_lv = other.ids + off;
       if (other_lv == item.orr) break;
+      collisions++;
       assert(other.state == 0);
 
       i64 other_left_lv = other.origin_left_at(off);
@@ -1908,6 +1913,8 @@ struct Ctx {
   // conflict zone's common-ancestor frontier (the version whose document
   // the tracker's underwater id space tiles)
   std::vector<i64> zone_common;
+  // collisions of the LAST transform (survives release_tracker)
+  i64 last_collisions = 0;
 };
 
 static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
@@ -1955,6 +1962,7 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
 static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->out.clear();
   c->last_tracker.reset();
+  c->last_collisions = 0;
   std::vector<Span> new_ops, conflict_ops;
   { PROF(conflict);
     c->zone_common = c->g.find_conflicting(
@@ -2041,6 +2049,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
         emit_ops_range(c, tracker, consume, true);
       }
     }
+    c->last_collisions = tracker.collisions;
   }
   c->out_frontier = next_frontier;
 }
@@ -2243,5 +2252,9 @@ i64 dt_get_counters(unsigned long long* out, i64 cap) {
 }
 
 void dt_reset_counters() { g_events = EventCounters{}; }
+
+// Colliding concurrent inserts during the last dt_transform on this ctx
+// (reference: has_conflicts_when_merging, src/list/merge.rs:51).
+i64 dt_last_collisions(void* p) { return ((Ctx*)p)->last_collisions; }
 
 }  // extern "C"

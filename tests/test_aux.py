@@ -148,3 +148,89 @@ def test_branch_wchar_edits():
     b.delete_at_wchar(ol, a, 1, 3)     # delete the emoji
     assert b.snapshot() == "x!y"
     assert ol.checkout_tip().snapshot() == b.snapshot()
+
+
+# ---- conflict detection (reference: has_conflicts_when_merging,
+# src/list/merge.rs:51; merge_conflict_checks, listmerge/mod.rs:50-51) ----
+
+def _conflict_fixture():
+    from diamond_types_tpu import OpLog
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    b = ol.get_or_create_agent_id("bob")
+    base = [ol.add_insert_at(a, [], 0, "hello world")]
+    return ol, a, b, base
+
+
+def test_conflicts_non_colliding():
+    """Concurrent edits at DIFFERENT positions: mergeable without any
+    insert-order ambiguity -> no conflicts."""
+    ol, a, b, base = _conflict_fixture()
+    ol.add_insert_at(a, base, 0, "A")       # front
+    ol.add_insert_at(b, base, 11, "B")      # back
+    assert ol.count_conflicts_when_merging([]) == 0
+    assert not ol.has_conflicts_when_merging([])
+    br = ol.checkout_tip()
+    assert br.last_merge_collisions in (0, None)
+    assert br.snapshot() == "Ahello worldB"
+
+
+def test_conflicts_colliding():
+    """Concurrent inserts at the SAME gap: the YjsMod tie-break fires."""
+    ol, a, b, base = _conflict_fixture()
+    ol.add_insert_at(a, base, 5, "A")
+    ol.add_insert_at(b, base, 5, "B")
+    assert ol.has_conflicts_when_merging([])
+    assert ol.count_conflicts_when_merging([]) >= 1
+    br = ol.checkout_tip()
+    assert br.last_merge_collisions >= 1
+    assert br.snapshot() == "helloAB world"   # alice < bob by name
+
+
+def test_conflicts_engine_agreement():
+    """Native and Python engines must agree on the collision verdict."""
+    import os
+    import random
+    from diamond_types_tpu import OpLog
+    from diamond_types_tpu.native import native_available
+    if not native_available():
+        import pytest
+        pytest.skip("native library unavailable")
+    rng = random.Random(31337)
+    from test_zone import random_edit
+    for trial in range(10):
+        ol = OpLog()
+        agents = [ol.get_or_create_agent_id(n) for n in ("a", "b")]
+        branches = [([], "")]
+        for _ in range(25):
+            bi = rng.randrange(len(branches))
+            version, content = branches[bi]
+            version, content = random_edit(
+                rng, ol, agents[rng.randrange(2)], version, content)
+            if rng.random() < 0.3 and len(branches) < 3:
+                branches.append((version, content))
+            else:
+                branches[bi] = (version, content)
+        native_n = ol.count_conflicts_when_merging([])
+        os.environ["DT_TPU_NO_NATIVE"] = "1"
+        try:
+            py_n = ol.count_conflicts_when_merging([])
+        finally:
+            del os.environ["DT_TPU_NO_NATIVE"]
+        # The VERDICT (has/has-not conflicts) must agree across engines;
+        # the COUNT is engine-specific (RLE run granularity differs
+        # between the C++ B-tree and the Python treap, so the number of
+        # integrate scan encounters differs — the reference itself only
+        # keeps a boolean flag).
+        assert (native_n > 0) == (py_n > 0), (trial, native_n, py_n)
+
+
+def test_conflicts_incremental_frontier():
+    """From a frontier that already contains one side, only the other
+    side's inserts can collide."""
+    ol, a, b, base = _conflict_fixture()
+    va = [ol.add_insert_at(a, base, 5, "A")]
+    ol.add_insert_at(b, base, 5, "B")
+    assert ol.has_conflicts_when_merging([])        # from scratch: collide
+    assert ol.has_conflicts_when_merging(va)        # folding B into A's doc
+    assert not ol.has_conflicts_when_merging(list(ol.version))  # no-op
