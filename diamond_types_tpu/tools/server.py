@@ -328,8 +328,15 @@ class SyncHandler(BaseHTTPRequestHandler):
                 # actually collide (concurrent inserts at one gap)?
                 # Surfaced so clients can flag ambiguous merges
                 # (reference: has_conflicts_when_merging, merge.rs:51).
+                # Cheap plan gate first: a push whose ops fast-forward
+                # from `pre` (no conflict zone) can't collide — skip the
+                # O(history) native transform for the common linear case.
                 try:
-                    collisions = ol.count_conflicts_when_merging(pre)
+                    from ..listmerge.plan2 import compile_plan2
+                    plan = compile_plan2(ol.cg.graph, pre,
+                                         list(ol.version))
+                    collisions = 0 if not plan.entries else \
+                        ol.count_conflicts_when_merging(pre)
                 except Exception:
                     collisions = None
             self.store.mark_dirty(doc_id)
@@ -425,14 +432,13 @@ class SyncHandler(BaseHTTPRequestHandler):
             from operator import index as _ix
             req = json.loads(body or b"{}")
             n = min(max(_ix(req.get("n", 16)), 1), 64)
-            # Freeze the frontier under the lock; compute OUTSIDE it.
-            # The oplog is append-only, so everything at or below the
-            # frozen frontier is immutable (readers slice runs by LV
-            # range) — and a slow/hung strip computation must not hold
-            # the store lock every other endpoint shares.
+            # Under the store lock like every other checkout endpoint:
+            # checkouts share the per-oplog native context, and a
+            # concurrent push rebuilding that context mid-call would be a
+            # use-after-free. (Host strips are a few hundred ms worst
+            # case; the device path is opt-in — see doc_history_strip.)
             with self.store.lock:
-                tip = list(ol.version)
-            snaps = doc_history_strip(ol, n, tip)
+                snaps = doc_history_strip(ol, n, list(ol.version))
             return self._send(200, json.dumps({"snapshots": snaps})
                               .encode("utf8"))
         if action == "at":
