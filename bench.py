@@ -324,6 +324,68 @@ def bench_device_zone(corpus: str, chunk: int, timeout: int = 600):
     return _run_device_bench_retry(code, timeout)
 
 
+_SESSION_SNIPPET = _PRELUDE + """
+import numpy as _np
+from diamond_types_tpu.encoding.decode import load_oplog
+from diamond_types_tpu.tpu.zone_session import DeviceZoneSession
+ol = load_oplog(open({data!r}, 'rb').read())
+agents = list(range(len(ol.cg.agent_assignment.agent_names)))
+t0 = time.perf_counter()
+sess = DeviceZoneSession(ol)
+sess.touch()
+build_ms = (time.perf_counter() - t0) * 1e3
+# realtime continuation: the corpus's agents keep typing from their own
+# heads (merge-per-edit; reference hot path src/list/merge.rs:63-96)
+heads = {{a: [sess._agent_last_lv(a)] for a in agents[:2]}}
+lens = {{a: len(ol.checkout(heads[a]).snapshot()) for a in agents[:2]}}
+import random as _rnd
+rng = _rnd.Random(7)
+def one_edit(i):
+    # length tracked incrementally: the TIMED region must contain only
+    # session work, not per-edit host checkouts
+    a = agents[i % 2]
+    pos = rng.randrange(max(lens[a], 1))
+    heads[a] = [ol.add_insert_at(a, heads[a], pos, 'q')]
+    lens[a] += 1
+# warmup (compile the micro-tape sizes)
+one_edit(0); sess.sync(); sess.touch()
+one_edit(1); sess.sync(); sess.touch()
+# timed: per-merge latency, single edit per sync
+ts = []
+for i in range(8):
+    one_edit(i)
+    t0 = time.perf_counter()
+    sess.sync(); sess.touch()
+    ts.append(time.perf_counter() - t0)
+per_merge_ms = min(ts) * 1e3
+# timed: batched edits per sync (amortizes the tunnel round trip)
+t0 = time.perf_counter()
+for i in range(32):
+    one_edit(i)
+sess.sync(); sess.touch()
+batch32_ms = (time.perf_counter() - t0) * 1e3
+assert sess.text() == ol.checkout_tip().snapshot(), \\
+    'session diverged from host engine'
+print("BUILD_MS", round(build_ms, 2))
+print("RESYNCS", sess.resyncs)
+print("BATCH32_MS", round(batch32_ms, 2))
+print("RESULT", round(per_merge_ms, 3))
+"""
+
+
+def bench_device_session(corpus: str = "friendsforever.dt",
+                         timeout: int = 600):
+    """Device-resident incremental session (VERDICT r2 #4): the document
+    state lives on the device across merges; each sync ships only the
+    composed micro-tape of the new ops. Reports per-merge latency
+    (includes one tunnel round trip — the touch() transfer) and the
+    32-edit batched variant; parity-checked against the host engine."""
+    code = _SESSION_SNIPPET.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        data=os.path.join(BENCH_DATA, corpus), liveness=LIVENESS_S)
+    return _run_device_bench_retry(code, timeout)
+
+
 _MERGE_SWEEP_SNIPPET = _PRELUDE + """
 from diamond_types_tpu.encoding.decode import load_oplog
 from diamond_types_tpu.tpu.merge_kernel import (prepare_doc, pad_docs,
@@ -498,7 +560,8 @@ def _run_device_phase(full: dict) -> dict:
         msg = f"device probe failed {attempts}: " + _short_err(probe)
         for k in ("tpu_batched_replay", "fanin_10k", "tpu_merge_git_makefile",
                   "tpu_merge_friendsforever", "tpu_merge_node_nodecc_sweep",
-                  "tpu_zone_git_makefile", "tpu_zone_friendsforever"):
+                  "tpu_zone_git_makefile", "tpu_zone_friendsforever",
+                  "tpu_session_friendsforever"):
             out[f"{k}_error"] = msg
         return out
     out["device_platform"] = probe.get("platform", "?")
@@ -567,6 +630,17 @@ def _run_device_phase(full: dict) -> dict:
         out["tpu_merge_friendsforever_per_call_ms"] = r.get("per_call_ms")
     else:
         out["tpu_merge_friendsforever_error"] = _short_err(r)
+
+    r = guarded("tpu_session_friendsforever",
+                lambda: bench_device_session())
+    if r.get("ok"):
+        out["tpu_session_per_merge_ms"] = round(r["value"], 3)
+        if r.get("batch32_ms") is not None:
+            out["tpu_session_batch32_ms"] = r.get("batch32_ms")
+        if r.get("build_ms") is not None:
+            out["tpu_session_build_ms"] = r.get("build_ms")
+    else:
+        out["tpu_session_friendsforever_error"] = _short_err(r)
 
     r = guarded("tpu_batched_replay", bench_tpu_batch)
     if r.get("ok"):

@@ -67,6 +67,9 @@ class MergePlan2:
     ff_spans: List[Span] = field(default_factory=list)
     final_frontier: List[int] = field(default_factory=list)
     common: List[int] = field(default_factory=list)  # zone common ancestor
+    # pin_lvs support: lv -> index holding that version's state row at
+    # plan end (the row is never dropped; device sessions resume from it)
+    pinned_rows: dict = field(default_factory=dict)
 
     def num_ops(self) -> int:
         n = sum(b - a for (a, b) in self.ff_spans)
@@ -140,14 +143,20 @@ def _build_subgraph(graph: Graph, zone_spans: List[Tuple[Span, bool]]
     return entries
 
 
-def _alloc_actions(entries: List[SubgraphEntry]) -> Tuple[List[tuple], int]:
-    """Refcounted index allocation over the topo order."""
+def _alloc_actions(entries: List[SubgraphEntry],
+                   pinned: Tuple[int, ...] = ()
+                   ) -> Tuple[List[tuple], int, dict]:
+    """Refcounted index allocation over the topo order. `pinned` entries
+    keep their row alive past plan end (an extra phantom use); the
+    returned dict maps pinned entry index -> row."""
     actions: List[tuple] = []
     free: List[int] = []
     next_idx = 0
     peak = 0
     row = [-1] * len(entries)
     uses = [en.num_children for en in entries]
+    for k in pinned:
+        uses[k] += 1
 
     def alloc() -> int:
         nonlocal next_idx, peak
@@ -182,11 +191,12 @@ def _alloc_actions(entries: List[SubgraphEntry]) -> Tuple[List[tuple], int]:
         if uses[k] == 0:
             actions.append((DROP, idx))
             free.append(idx)
-    return actions, peak
+    return actions, peak, {k: row[k] for k in pinned}
 
 
 def compile_plan2(graph: Graph, from_frontier: List[int],
-                  merge_frontier: List[int]) -> MergePlan2:
+                  merge_frontier: List[int],
+                  pin_lvs: Tuple[int, ...] = ()) -> MergePlan2:
     """Conflict analysis + fast-forward extraction + fork/join schedule.
     Mirrors the control-flow split of plan.compile_plan; the emitted schedule
     is the listmerge2 action algebra instead of a retreat/advance tape."""
@@ -244,7 +254,19 @@ def compile_plan2(graph: Graph, from_frontier: List[int],
         plan.entries = [entries[old_k] for old_k in perm]
         for en in plan.entries:
             en.parents = tuple(inv[p] for p in en.parents)
-        plan.actions, plan.indexes_used = _alloc_actions(plan.entries)
+        # pin: entries whose LAST lv is a requested pin point keep their
+        # state row alive for session resumption (zone_session.py)
+        pins = []
+        pin_entry = {}
+        for lv in pin_lvs:
+            for k, en in enumerate(plan.entries):
+                if en.span[1] - 1 == lv:
+                    pins.append(k)
+                    pin_entry[k] = lv
+                    break
+        plan.actions, plan.indexes_used, rowmap = _alloc_actions(
+            plan.entries, tuple(pins))
+        plan.pinned_rows = {pin_entry[k]: r for k, r in rowmap.items()}
         for en in plan.entries:
             if en.emit:
                 graph.advance_frontier(next_frontier, en.span)
@@ -290,5 +312,6 @@ def validate_plan2(plan: MergePlan2) -> None:
             sim[idx] = sim[idx] | {k}
         live_peak = max(live_peak, len(sim))
     assert all(applied), "some entries never applied"
-    assert not sim, "indexes leaked at end of plan"
+    assert set(sim.keys()) <= set(plan.pinned_rows.values()), \
+        "indexes leaked at end of plan (beyond the pinned rows)"
     assert live_peak <= plan.indexes_used

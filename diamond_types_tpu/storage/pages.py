@@ -174,23 +174,31 @@ class PagedStore:
             buf = buf[:good]
             n_full = len(buf) // PAYLOAD
             ch.pages = []
+            seal_seq = max_seq.get((stream, gen), 0) + 1
             for i in range(n_full):
                 entry = main_slot.get((stream, gen, i))
-                if entry is None:
-                    slot = self._alloc()
+                content = buf[i * PAYLOAD:(i + 1) * PAYLOAD]
+                bgen, bseq, bpayload = best[(stream, i)]
+                if entry is None or entry[0] < bseq:
+                    # the newest image of this finalized page lives on the
+                    # blit slot (tail filled on an odd write) — re-seal it
+                    # at a main slot NOW, or the next blit reuse would
+                    # leave only the stale main image to a later recovery
+                    slot = entry[1] if entry is not None else self._alloc()
                     self._write_page(slot, stream, 0, PAYLOAD, i, gen,
-                                     1, buf[i * PAYLOAD:(i + 1) * PAYLOAD])
+                                     seal_seq, content)
                 else:
                     slot = entry[1]
                 ch.pages.append(slot)
-                self._full[(stream, i)] = buf[i * PAYLOAD:(i + 1) * PAYLOAD]
+                self._full[(stream, i)] = content
             ch.tail_data = buf[n_full * PAYLOAD:]
             tm = main_slot.get((stream, gen, n_full))
             ch.tail_main = None if tm is None else tm[1]
             # new tail writes must outrank ANY stale image of this chain
             # (a torn-record rollback can re-point the tail at a page
-            # whose on-disk image carries a higher seq)
-            ch.tail_seq = max_seq.get((stream, gen), ch.tail_seq)
+            # whose on-disk image carries a higher seq; ditto re-sealed
+            # finalized pages above)
+            ch.tail_seq = seal_seq
             self._chains[stream] = ch
 
     # ---- write path ------------------------------------------------------

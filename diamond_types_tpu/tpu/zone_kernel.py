@@ -73,6 +73,8 @@ class ZoneTape:
     ch_ol_coord: np.ndarray    # [T,MC] i32 entry-start coord
     ch_orr_own: np.ndarray     # [T,MC] i32 slot or -1 (block B)
     ch_blk: np.ndarray         # [T,MC] i32 block index in step
+    ch_agent: np.ndarray       # [T,MC] i32 agent name rank
+    ch_seq: np.ndarray         # [T,MC] i32 agent-local seq
     # per step x delete atom
     del_kind: np.ndarray    # [T,MD] i32 -1 pad / 0 coords / 1 slot range
     del_a: np.ndarray       # [T,MD] i32
@@ -83,6 +85,65 @@ class ZoneTape:
     n_idx: int
     pool: np.ndarray        # [W] i32 char codes by slot
     total_steps: int
+
+
+def entry_steps(ce, slot_fn, agent_k, seq_k, MB, MC, MD, cur, next_sub):
+    """Append one composed entry's APPLY sub-step contents (blocks, char
+    slices, delete atoms) under the shared budgets. `slot_fn` maps insert
+    LVs to char slots; `cur` is the current step dict; `next_sub()`
+    returns a fresh sub-step. Shared by the whole-document packer below
+    and the incremental session packer (zone_session.py)."""
+    nc = ce.num_chars()
+    if nc:
+        slots = slot_fn(ce.ch_lv).astype(np.int64)
+        anchor = np.where(ce.ch_anchor >= 0,
+                          slot_fn(np.maximum(ce.ch_anchor, 0)), -1)
+        orr_own = np.where(ce.ch_orrown >= 0,
+                           slot_fn(np.maximum(ce.ch_orrown, 0)), -1)
+        root_slots = slot_fn(ce.blk_root_lv)
+        qc = np.asarray(ce.q_cursor, dtype=np.int64) \
+            if ce.q_cursor else np.zeros(1, np.int64)
+        c_of = qc[np.clip(ce.ch_q, 0, None)]
+        is_q = ce.ch_kind >= 2      # K_LEFTJOIN / K_ROOT heads
+        ol_static = np.where(
+            ce.ch_kind == 0, slots - 1,
+            np.where(ce.ch_kind == K_OWN, anchor,
+                     np.where(c_of == 0, -1, -2)))
+        ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
+        ag = np.asarray(agent_k)[slots] if not callable(agent_k) \
+            else agent_k(ce.ch_lv)
+        sq = np.asarray(seq_k)[slots] if not callable(seq_k) \
+            else seq_k(ce.ch_lv)
+    for b in range(len(ce.blk_start) if nc else 0):
+        lo = int(ce.blk_start[b])
+        hi = lo + int(ce.blk_len[b])
+        first = True
+        pos = lo
+        while pos < hi:
+            if len(cur["blocks"]) >= MB or cur["n_chars"] >= MC:
+                cur = next_sub()
+            take = min(hi - pos, MC - cur["n_chars"])
+            assert take > 0
+            cursor = int(ce.q_cursor[int(ce.blk_root_q[b])]) \
+                if first else -2
+            cur["blocks"].append((
+                cursor, -1 if first else int(slots[pos - 1]),
+                int(root_slots[b]), cur["n_chars"], take))
+            cur["chars"].append((len(cur["blocks"]) - 1, pos, pos + take,
+                                 slots, ol_static, ol_coord, orr_own,
+                                 ag, sq))
+            cur["n_chars"] += take
+            pos += take
+            first = False
+    for (c0, c1) in ce.del_base:
+        if len(cur["dels"]) >= MD:
+            cur = next_sub()
+        cur["dels"].append((0, int(c0), int(c1)))
+    for (lv0, lv1) in ce.del_own:
+        if len(cur["dels"]) >= MD:
+            cur = next_sub()
+        s0 = int(slot_fn(np.asarray([lv0]))[0])
+        cur["dels"].append((1, s0, s0 + (lv1 - lv0)))
 
 
 def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
@@ -112,61 +173,25 @@ def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
             row = act[2]
             cur = new_step(OP_APPLY, row, snap=1)
 
-            def next_sub(s):
+            def next_sub():
                 return new_step(OP_APPLY, row, snap=0)
 
-            nc = ce.num_chars()
-            if nc:
-                # per-char columns, vectorized once per entry (this code
-                # is inside the bench's HOST_PREP_MS)
-                slots = _slot_of(prep, ce.ch_lv).astype(np.int64)
-                anchor = np.where(
-                    ce.ch_anchor >= 0,
-                    _slot_of(prep, np.maximum(ce.ch_anchor, 0)), -1)
-                orr_own = np.where(
-                    ce.ch_orrown >= 0,
-                    _slot_of(prep, np.maximum(ce.ch_orrown, 0)), -1)
-                root_slots = _slot_of(prep, ce.blk_root_lv)
-                qc = np.asarray(ce.q_cursor, dtype=np.int64) \
-                    if ce.q_cursor else np.zeros(1, np.int64)
-                c_of = qc[np.clip(ce.ch_q, 0, None)]
-                is_q = ce.ch_kind >= 2      # K_LEFTJOIN / K_ROOT heads
-                ol_static = np.where(
-                    ce.ch_kind == 0, slots - 1,
-                    np.where(ce.ch_kind == K_OWN, anchor,
-                             np.where(c_of == 0, -1, -2)))
-                ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
-            for b in range(len(ce.blk_start) if nc else 0):
-                lo = int(ce.blk_start[b])
-                hi = lo + int(ce.blk_len[b])
-                first = True
-                pos = lo
-                while pos < hi:
-                    if len(cur["blocks"]) >= MB or cur["n_chars"] >= MC:
-                        cur = next_sub(cur)
-                    take = min(hi - pos, MC - cur["n_chars"])
-                    assert take > 0
-                    cursor = int(ce.q_cursor[int(ce.blk_root_q[b])]) \
-                        if first else -2
-                    cur["blocks"].append((
-                        cursor, -1 if first else int(slots[pos - 1]),
-                        int(root_slots[b]), cur["n_chars"], take))
-                    cur["chars"].append((len(cur["blocks"]) - 1,
-                                         pos, pos + take, slots,
-                                         ol_static, ol_coord, orr_own))
-                    cur["n_chars"] += take
-                    pos += take
-                    first = False
-            for (c0, c1) in ce.del_base:
-                if len(cur["dels"]) >= MD:
-                    cur = next_sub(cur)
-                cur["dels"].append((0, int(c0), int(c1)))
-            for (lv0, lv1) in ce.del_own:
-                if len(cur["dels"]) >= MD:
-                    cur = next_sub(cur)
-                s0 = int(_slot_of(prep, np.asarray([lv0]))[0])
-                cur["dels"].append((1, s0, s0 + (lv1 - lv0)))
+            def slot_fn(lvs):
+                return _slot_of(prep, lvs)
 
+            entry_steps(ce, slot_fn, prep.agent_k, prep.seq_k,
+                        MB, MC, MD, cur, next_sub)
+
+    return _fill_tape(steps, prep.W, prep.plen,
+                      max(1, prep.plan.indexes_used),
+                      prep.pool.astype(np.int32), MB, MC, MD)
+
+
+def _fill_tape(steps: List[dict], W: int, plen: int, n_idx: int,
+               pool: np.ndarray, MB: int, MC: int, MD: int) -> ZoneTape:
+    """Materialize packed micro-step dicts into tape arrays (shared by
+    the whole-document packer above and zone_session's incremental
+    packer)."""
     T = max(1, len(steps))
     out = ZoneTape(
         op=np.zeros(T, np.int32), arg_a=np.zeros(T, np.int32),
@@ -181,11 +206,13 @@ def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
         ch_ol_coord=np.zeros((T, MC), np.int32),
         ch_orr_own=np.full((T, MC), -1, np.int32),
         ch_blk=np.zeros((T, MC), np.int32),
+        ch_agent=np.zeros((T, MC), np.int32),
+        ch_seq=np.zeros((T, MC), np.int32),
         del_kind=np.full((T, MD), -1, np.int32),
         del_a=np.zeros((T, MD), np.int32),
         del_b=np.zeros((T, MD), np.int32),
-        W=prep.W, plen=prep.plen, n_idx=max(1, prep.plan.indexes_used),
-        pool=prep.pool.astype(np.int32), total_steps=len(steps))
+        W=W, plen=plen, n_idx=n_idx,
+        pool=pool, total_steps=len(steps))
     for t, s in enumerate(steps):
         out.op[t] = s["op"]
         out.arg_a[t] = s["a"]
@@ -199,14 +226,16 @@ def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
             out.blk_start[t, i] = start
             out.blk_len[t, i] = length
         w = 0
-        for (blk_i, lo, hi, slots, ol_static, ol_coord, orr_own) in \
-                s["chars"]:
+        for (blk_i, lo, hi, slots, ol_static, ol_coord, orr_own,
+             ag, sq) in s["chars"]:
             n = hi - lo
             out.ch_slot[t, w:w + n] = slots[lo:hi]
             out.ch_ol_static[t, w:w + n] = ol_static[lo:hi]
             out.ch_ol_coord[t, w:w + n] = ol_coord[lo:hi]
             out.ch_orr_own[t, w:w + n] = orr_own[lo:hi]
             out.ch_blk[t, w:w + n] = blk_i
+            out.ch_agent[t, w:w + n] = ag[lo:hi]
+            out.ch_seq[t, w:w + n] = sq[lo:hi]
             w += n
         for i, (k, a, b) in enumerate(s["dels"]):
             out.del_kind[t, i] = k
@@ -220,9 +249,13 @@ def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
 # ---------------------------------------------------------------------------
 
 
-def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
-              MC: int, MD: int):
-    """Jitted whole-tape execution: one lax.scan, returns (rank, ever)."""
+def make_zone_step(W: int, plen: int, n_idx: int, MB: int, MC: int,
+                  MD: int):
+    """Build the scan-step function over the zone carry. The carry is
+    (state, snap, rank, ord, ol_id, orr_id, ever, m, agent_k, seq_k) —
+    agent/seq key planes ride in the carry and are updated from the tape,
+    so an incremental caller (zone_session.py) ships only per-char deltas
+    per step instead of re-uploading whole key arrays."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -234,7 +267,14 @@ def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
         return jnp.where(ix >= 0, arr[jnp.clip(ix, 0, W - 1)], fill)
 
     def apply_step(carry, x):
-        state, snap, rank, ordv, ol_id, orr_id, ever, m = carry
+        (state, snap, rank, ordv, ol_id, orr_id, ever, m,
+         agent_k, seq_k) = carry
+        # key planes first: the chars placed THIS step are roots/anchors
+        # whose keys the integrate scan reads
+        ch_ok = x["ch_slot"] >= 0
+        key_ix = jnp.where(ch_ok, x["ch_slot"], W)
+        agent_k = agent_k.at[key_ix].set(x["ch_agent"], mode="drop")
+        seq_k = seq_k.at[key_ix].set(x["ch_seq"], mode="drop")
         row = jnp.clip(x["a"], 0, n_idx - 1)
         st_row = lax.dynamic_index_in_dim(state, row, 0, keepdims=False)
         snap = jnp.where(x["snap"] == 1, st_row, snap)
@@ -363,10 +403,11 @@ def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
         new_row = jnp.maximum(jnp.maximum(st_row, ins_w), del_w)
         state = lax.dynamic_update_index_in_dim(state, new_row, row, 0)
         ever = jnp.maximum(ever, (del_w >= 2).astype(jnp.uint8))
-        return (state, snap, rank, ordv, ol_id, orr_id, ever, m), None
+        return (state, snap, rank, ordv, ol_id, orr_id, ever, m,
+                agent_k, seq_k), None
 
     def row_step(carry, x):
-        state, snap, rank, ordv, ol_id, orr_id, ever, m = carry
+        state = carry[0]
         op = x["op"]
         src = lax.dynamic_index_in_dim(
             state, jnp.clip(x["a"], 0, n_idx - 1), 0, keepdims=False)
@@ -378,23 +419,40 @@ def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
         target = jnp.where(op == OP_BEGIN, x["a"], x["b"])
         state = lax.dynamic_update_index_in_dim(
             state, new, jnp.clip(target, 0, n_idx - 1), 0)
-        return (state, snap, rank, ordv, ol_id, orr_id, ever, m), None
+        return (state,) + tuple(carry[1:]), None
 
     def step(carry, x):
         return lax.cond(x["op"] == OP_APPLY, apply_step, row_step,
                         carry, x)
 
-    state0 = jnp.zeros((n_idx, W), jnp.uint8)
-    snap0 = jnp.zeros(W, jnp.uint8)
-    rank0 = jnp.where(idx_w < plen, idx_w, BIG32)
-    ord0 = idx_w
-    ol0 = jnp.where(idx_w < plen, idx_w - 1, -2)
-    orr0 = jnp.full(W, -1, jnp.int32)
-    carry = (state0, snap0, rank0, ord0, ol0, orr0,
-             jnp.zeros(W, jnp.uint8), jnp.int32(plen))
-    (state, snap, rank, ordv, ol_id, orr_id, ever, m), _ = lax.scan(
-        step, carry, xs)
-    return rank, ever
+    return step
+
+
+def init_zone_carry(W: int, plen: int, n_idx: int, agent_k, seq_k):
+    """Fresh carry for a zone execution (prefix chars pre-placed)."""
+    import jax.numpy as jnp
+    idx_w = jnp.arange(W, dtype=jnp.int32)
+    return (jnp.zeros((n_idx, W), jnp.uint8),          # state matrix
+            jnp.zeros(W, jnp.uint8),                   # entry snapshot
+            jnp.where(idx_w < plen, idx_w, BIG32),     # rank
+            idx_w,                                     # ord
+            jnp.where(idx_w < plen, idx_w - 1, -2),    # ol_id
+            jnp.full(W, -1, jnp.int32),                # orr_id
+            jnp.zeros(W, jnp.uint8),                   # ever
+            jnp.int32(plen),                           # m
+            jnp.asarray(agent_k, jnp.int32),
+            jnp.asarray(seq_k, jnp.int32))
+
+
+def _run_zone(xs, agent_k, seq_k, W: int, plen: int, n_idx: int, MB: int,
+              MC: int, MD: int):
+    """Jitted whole-tape execution: one lax.scan, returns (rank, ever)."""
+    from jax import lax
+
+    step = make_zone_step(W, plen, n_idx, MB, MC, MD)
+    carry = init_zone_carry(W, plen, n_idx, agent_k, seq_k)
+    final, _ = lax.scan(step, carry, xs)
+    return final[2], final[6]
 
 
 _zone_jit_cache = {}
@@ -465,8 +523,11 @@ def _pad_tape_xs(tape: ZoneTape) -> dict:
         return out
 
     return dict(
-        op=pad_t(tape.op), a=pad_t(tape.arg_a), b=pad_t(tape.arg_b),
-        snap=pad_t(tape.snap_flag),
+        # pad steps are self-FORKs (state[0] <- state[0]): a padded
+        # OP_BEGIN would reset row 0 to the base prefix and clobber any
+        # pinned session row held there
+        op=pad_t(tape.op, OP_FORK), a=pad_t(tape.arg_a),
+        b=pad_t(tape.arg_b), snap=pad_t(tape.snap_flag),
         blk_cursor=pad_t(tape.blk_cursor, -1),
         blk_prev=pad_t(tape.blk_prev, -1), blk_root=pad_t(tape.blk_root),
         blk_start=pad_t(tape.blk_start), blk_len=pad_t(tape.blk_len),
@@ -474,6 +535,7 @@ def _pad_tape_xs(tape: ZoneTape) -> dict:
         ch_ol_static=pad_t(tape.ch_ol_static, -1),
         ch_ol_coord=pad_t(tape.ch_ol_coord),
         ch_orr_own=pad_t(tape.ch_orr_own, -1), ch_blk=pad_t(tape.ch_blk),
+        ch_agent=pad_t(tape.ch_agent), ch_seq=pad_t(tape.ch_seq),
         del_kind=pad_t(tape.del_kind, -1), del_a=pad_t(tape.del_a),
         del_b=pad_t(tape.del_b))
 

@@ -214,3 +214,16 @@ def test_paged_crash_fuzz(tmp_path):
         assert got2[-1] == b"post-crash"
         assert got2[:-1] == recs[:len(got2) - 1]
         s3.close()
+        # SECOND crash cycle: recovery itself must leave a state that
+        # survives another torn write (regression: a finalized page whose
+        # newest image lived on the blit slot must be re-sealed at a main
+        # slot during recovery, or the next blit reuse orphans it)
+        data = open(p, "rb").read()
+        cut = rng.randrange(max(1, len(data) - 2048), len(data))
+        open(p, "wb").write(data[:cut])
+        s4 = PagedStore(p)
+        got3 = list(s4.records(1))
+        expect_all = recs[:len(got2) - 1] + [b"post-crash"]
+        assert got3 == expect_all[:len(got3)], \
+            f"trial {trial}: second crash broke the prefix invariant"
+        s4.close()
