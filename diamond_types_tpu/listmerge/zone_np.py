@@ -69,16 +69,20 @@ def _slot_of(prep: ZonePrep, lvs: np.ndarray) -> np.ndarray:
 
 def prepare_zone(oplog, from_frontier: Sequence[int] = (),
                  merge_frontier: Optional[Sequence[int]] = None,
-                 prefix: Optional[str] = None) -> ZonePrep:
+                 prefix: Optional[str] = None,
+                 pin_lvs: Sequence[int] = ()) -> ZonePrep:
     """Host pass: plan + composition + slot/pool/key tables.
 
     `prefix` overrides the doc at the zone's common ancestor (an
-    incremental caller that already holds it skips the replay)."""
+    incremental caller that already holds it skips the replay).
+    `pin_lvs` threads through to compile_plan2 (state rows kept alive at
+    those versions — device sessions resume from them)."""
     from ..tpu.merge_kernel import _agent_keys
 
     merge = list(oplog.version) if merge_frontier is None \
         else list(merge_frontier)
-    plan = compile_plan2(oplog.cg.graph, list(from_frontier), merge)
+    plan = compile_plan2(oplog.cg.graph, list(from_frontier), merge,
+                         pin_lvs=tuple(pin_lvs))
     composed = compose_plan(oplog, plan)
 
     if prefix is None:

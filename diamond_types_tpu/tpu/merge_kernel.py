@@ -25,6 +25,7 @@ length, so they sort to the end and contribute no text.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence
@@ -168,8 +169,11 @@ def prepare_doc(oplog, from_frontier: Sequence[int] = (),
 
 
 def _checkout_kernel(parent, side, key_pos, key_agent, key_seq, vis_len,
-                     char_off, chars, cap: int):
+                     char_off, chars, cap: int, pallas: bool = False):
     perm = fugue_linearize_jax(parent, side, key_pos, key_agent, key_seq)
+    if pallas:
+        from .pallas_kernels import materialize_pallas
+        return materialize_pallas(perm, vis_len, char_off, chars, cap)
     return materialize_jax(perm, vis_len, char_off, chars, cap)
 
 
@@ -183,12 +187,16 @@ def _pow2(x: int) -> int:
 def _jitted_kernel(cap: int):
     """Compiled batched kernels keyed by the (power-of-two) capacity so
     growing documents reuse O(log max_len) compiled executables instead of
-    recompiling per exact length."""
-    fn = _kernel_cache.get(cap)
+    recompiling per exact length. DT_TPU_PALLAS=1 selects the Pallas
+    materialize stage (pallas_kernels.materialize_pallas)."""
+    pallas = bool(os.environ.get("DT_TPU_PALLAS"))
+    key = (cap, pallas)
+    fn = _kernel_cache.get(key)
     if fn is None:
         import jax
-        fn = jax.jit(jax.vmap(partial(_checkout_kernel, cap=cap)))
-        _kernel_cache[cap] = fn
+        fn = jax.jit(jax.vmap(partial(_checkout_kernel, cap=cap,
+                                      pallas=pallas)))
+        _kernel_cache[key] = fn
     return fn
 
 

@@ -75,6 +75,112 @@ def apply_op_block(pos, dlen, ilen, chars, doc, doc_len, *,
     )(pos, dlen, ilen, chars, doc, doc_len)
 
 
+# ---------------------------------------------------------------------------
+# materialize: run-expansion as a Pallas kernel (VERDICT r2 next-step #5)
+# ---------------------------------------------------------------------------
+
+
+def _materialize_kernel(starts_ref, base_ref, arena_ref, total_ref,
+                        out_ref, *, n_pow: int):
+    """Expand visible runs into text for one [block] of output positions.
+
+    Gather-only formulation (TPU Pallas has fast gathers, no fast
+    scatter): each output position j binary-searches the compacted live
+    runs' start table (log2(n) vectorized steps), then reads its char
+    through the run's affine base. Replaces materialize_jax's
+    scatter+cummax run expansion for the device merge path."""
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, out_ref.shape[1]), 1) + \
+        pl.program_id(0) * out_ref.shape[1]
+    starts = starts_ref[...]               # [1, n] (+inf padded, sorted)
+    base = base_ref[...]                   # [1, n]
+    arena = arena_ref[...]                 # [1, A]
+    total = total_ref[0]
+
+    # binary search: largest r with starts[r] <= j
+    lo = jnp.zeros_like(j)
+    for _ in range(n_pow):
+        step = jnp.full_like(j, 1 << (n_pow - 1)) if _ == 0 else step // 2
+        probe = lo + step
+        pv = jnp.take_along_axis(
+            starts, jnp.clip(probe, 0, starts.shape[1] - 1), axis=1)
+        lo = jnp.where((probe < starts.shape[1]) & (pv <= j), probe, lo)
+    b = jnp.take_along_axis(base, lo, axis=1)
+    src = jnp.clip(b + j, 0, arena.shape[1] - 1)
+    text = jnp.take_along_axis(arena, src, axis=1)
+    out_ref[...] = jnp.where(j < total, text, 0)
+
+
+def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
+                       interpret: bool = False):
+    """Drop-in for linearize.materialize_jax with the run expansion in a
+    Pallas kernel. The XLA pre-pass compacts live runs (sorted starts +
+    affine bases — one cumsum and one scatter over [n]); the [cap]-wide
+    expansion (the hot part) runs in VMEM."""
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True   # CPU/GPU backends run the kernel interpreted
+    n = perm.shape[0]
+    vl = vis_len[perm]
+    cum = jnp.cumsum(vl)
+    total = (cum[-1] if n else jnp.int32(0)).astype(jnp.int32)
+    starts = cum - vl
+    base = arena_off[perm] - starts
+    live = vl > 0
+    # compact live runs to a sorted prefix; pad tail with +inf starts
+    k = jnp.cumsum(live.astype(jnp.int32)) - 1
+    n_pad = max(1, _next_pow2(n))
+    INF = jnp.int32(2 ** 30)
+    starts_c = jnp.full((n_pad,), INF, jnp.int32).at[
+        jnp.where(live, k, n_pad - 1)].set(
+        jnp.where(live, starts, INF).astype(jnp.int32), mode="drop")
+    base_c = jnp.zeros((n_pad,), jnp.int32).at[
+        jnp.where(live, k, n_pad - 1)].set(
+        jnp.where(live, base, 0).astype(jnp.int32), mode="drop")
+    # guard slot 0: with no live runs at position 0 the search floor must
+    # still be a valid run for padded positions (masked by `total` anyway)
+    arena_i = arena.astype(jnp.int32)
+    A = arena_i.shape[0]
+
+    block = min(cap, 64 * 1024)
+    grid = (cap + block - 1) // block
+    kwargs = {}
+    if not interpret and _VMEM is not None:
+        kwargs = {
+            "in_specs": [
+                pl.BlockSpec((1, n_pad), lambda i: (0, 0),
+                             memory_space=_VMEM),
+                pl.BlockSpec((1, n_pad), lambda i: (0, 0),
+                             memory_space=_VMEM),
+                pl.BlockSpec((1, A), lambda i: (0, 0),
+                             memory_space=_VMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            "out_specs": pl.BlockSpec((1, block), lambda i: (0, i),
+                                      memory_space=_VMEM),
+        }
+    else:
+        kwargs = {
+            "in_specs": [pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+                         pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
+                         pl.BlockSpec((1, A), lambda i: (0, 0)),
+                         pl.BlockSpec((1,), lambda i: (0,))],
+            "out_specs": pl.BlockSpec((1, block), lambda i: (0, i)),
+        }
+    out = pl.pallas_call(
+        functools.partial(_materialize_kernel,
+                          n_pow=max(1, (n_pad - 1).bit_length())),
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct((1, grid * block), jnp.int32),
+        interpret=interpret,
+        **kwargs,
+    )(starts_c[None, :], base_c[None, :], arena_i[None, :],
+      total[None])
+    return out[0, :cap], total
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(1, int(x) - 1).bit_length()
+
+
 @functools.partial(jax.jit, static_argnames=("cap", "interpret"))
 def replay_batch_pallas(pos, dlen, ilen, chars, cap: int,
                         interpret: bool = False):

@@ -110,10 +110,11 @@ def entry_steps(ce, slot_fn, agent_k, seq_k, MB, MC, MD, cur, next_sub):
             np.where(ce.ch_kind == K_OWN, anchor,
                      np.where(c_of == 0, -1, -2)))
         ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
-        ag = np.asarray(agent_k)[slots] if not callable(agent_k) \
-            else agent_k(ce.ch_lv)
-        sq = np.asarray(seq_k)[slots] if not callable(seq_k) \
-            else seq_k(ce.ch_lv)
+        if callable(agent_k):   # one call yields both key planes
+            ag, sq = agent_k(ce.ch_lv)
+        else:
+            ag = np.asarray(agent_k)[slots]
+            sq = np.asarray(seq_k)[slots]
     for b in range(len(ce.blk_start) if nc else 0):
         lo = int(ce.blk_start[b])
         hi = lo + int(ce.blk_len[b])

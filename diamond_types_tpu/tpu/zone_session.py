@@ -30,9 +30,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..listmerge.compose import compose_entry
-from ..listmerge.plan2 import compile_plan2
+
 from ..listmerge.zone_np import ZonePrep, prepare_zone
-from .merge_kernel import _agent_keys, _pow2
+from .merge_kernel import _pow2
 from .zone_kernel import (BIG32, OP_APPLY, OP_FORK, OP_MAX, ZoneTape,
                           _pad_tape_xs, init_zone_carry, make_zone_step,
                           pack_zone_tape)
@@ -121,11 +121,7 @@ class DeviceZoneSession:
                 self._agent_last_lv(agent)
             if last is not None:
                 heads.append(last)
-        prep = prepare_zone(ol)
-        # recompile with pinned rows (same entries — the compile is
-        # deterministic; pinning only changes refcounts/actions)
-        prep.plan = compile_plan2(ol.cg.graph, [], list(ol.version),
-                                  pin_lvs=tuple(heads))
+        prep = prepare_zone(ol, pin_lvs=tuple(heads))
         self.prep = prep
         W_cap = _pow2(max(int(prep.W * self.headroom), prep.W + 1024))
         n_rows = max(self.n_rows, prep.plan.indexes_used)
@@ -163,6 +159,8 @@ class DeviceZoneSession:
             self.free_rows.discard(row)
         self.n_rows_eff = n_rows
         self.synced_to = len(ol)
+        self._lru.clear()          # stale frontiers died with the old rows
+        self._keys_cache = None
         # always track the merged TIP as a row (derivable from rank/ever:
         # visible = placed and never deleted): linear histories have no
         # zone entries to pin, and most realtime ops parent on the tip
@@ -194,6 +192,27 @@ class DeviceZoneSession:
     def _touch_key(self, key) -> None:
         self._clock += 1
         self._lru[key] = self._clock
+
+    def _keys(self, lvs: np.ndarray):
+        """(agent name rank, seq) per LV with the run tables cached per
+        sync epoch — _agent_keys rebuilds them from scratch on every call,
+        which is O(total history) per entry on the hot path."""
+        aa = self.oplog.cg.agent_assignment
+        gr = aa.global_runs
+        cache = self._keys_cache
+        if cache is None or cache[0] != len(gr):
+            lv0 = np.asarray([r[0] for r in gr], dtype=np.int64)
+            ag = np.asarray([r[2] for r in gr], dtype=np.int64)
+            sq0 = np.asarray([r[3] for r in gr], dtype=np.int64)
+            o = np.argsort(lv0)
+            name_rank = np.asarray(np.argsort(np.argsort(aa.agent_names)))
+            cache = (len(gr), lv0[o], ag[o], sq0[o], name_rank)
+            self._keys_cache = cache
+        _, lv0, ag, sq0, name_rank = cache
+        lvs = np.asarray(lvs, dtype=np.int64)
+        j = np.clip(np.searchsorted(lv0, lvs, side="right") - 1, 0,
+                    len(lv0) - 1)
+        return name_rank[ag[j]], sq0[j] + (lvs - lv0[j])
 
     def _agent_last_lv(self, agent: int) -> Optional[int]:
         aa = self.oplog.cg.agent_assignment
@@ -333,9 +352,7 @@ class DeviceZoneSession:
             steps.append(s)
             return s
 
-        entry_steps(ce, self._slot_of_lv,
-                    lambda lvs: _agent_keys(self.oplog, lvs)[0],
-                    lambda lvs: _agent_keys(self.oplog, lvs)[1],
+        entry_steps(ce, self._slot_of_lv, self._keys, None,
                     self.MB, self.MC, self.MD, cur, next_sub)
         return steps
 

@@ -223,3 +223,63 @@ def test_replay_out_of_contract_ops_poison_length():
     _docs, lens = replay_batch(jnp.asarray(pos), jnp.asarray(dl),
                                jnp.asarray(il), jnp.asarray(chars), cap=16)
     assert int(np.asarray(lens)[0]) == -1
+
+
+def test_materialize_pallas_parity():
+    """Pallas run-expansion (interpret mode) vs materialize_jax on random
+    run tables and on a real corpus's device-doc tables."""
+    import jax.numpy as jnp
+    import numpy as np
+    import random
+    from diamond_types_tpu.tpu.linearize import materialize_jax
+    from diamond_types_tpu.tpu.pallas_kernels import materialize_pallas
+
+    rng = random.Random(77)
+    for trial in range(12):
+        n = rng.randint(1, 50)
+        vis = np.array([rng.choice([0, 0, 1, 2, 5]) for _ in range(n)],
+                       dtype=np.int32)
+        arena = np.arange(1000, dtype=np.int32) + 100
+        off = np.array([rng.randrange(900) for _ in range(n)],
+                       dtype=np.int32)
+        perm = np.random.RandomState(trial).permutation(n).astype(np.int32)
+        cap = int(max(8, 1 << int(vis.sum()).bit_length()))
+        t1, n1 = materialize_jax(jnp.asarray(perm), jnp.asarray(vis),
+                                 jnp.asarray(off), jnp.asarray(arena),
+                                 cap=cap)
+        t2, n2 = materialize_pallas(jnp.asarray(perm), jnp.asarray(vis),
+                                    jnp.asarray(off), jnp.asarray(arena),
+                                    cap=cap, interpret=True)
+        assert int(n1) == int(n2)
+        assert np.array_equal(np.asarray(t1)[:int(n1)],
+                              np.asarray(t2)[:int(n2)]), f"trial {trial}"
+
+
+def test_materialize_pallas_corpus():
+    """Byte parity through the full merge-kernel path with the Pallas
+    materialize stage swapped in (friendsforever corpus)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from conftest import reference_path
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.tpu.merge_kernel import prepare_doc
+    from diamond_types_tpu.tpu.linearize import fugue_linearize_jax
+    from diamond_types_tpu.tpu.pallas_kernels import materialize_pallas
+
+    with open(reference_path("benchmark_data", "friendsforever.dt"),
+              "rb") as f:
+        ol = load_oplog(f.read())
+    doc = prepare_doc(ol)
+    n = doc.parent.shape[0]
+    perm = fugue_linearize_jax(
+        jnp.asarray(np.where(doc.parent == n, n, doc.parent)),
+        jnp.asarray(doc.side.astype(np.int32)),
+        jnp.asarray(doc.key_pos), jnp.asarray(doc.key_agent),
+        jnp.asarray(doc.key_seq))
+    cap = 1 << int(doc.total_len).bit_length()
+    text, total = materialize_pallas(
+        perm, jnp.asarray(doc.vis_len), jnp.asarray(doc.char_off),
+        jnp.asarray(doc.chars), cap=cap, interpret=True)
+    got = np.asarray(text)[:int(total)].astype(np.int32).tobytes() \
+        .decode("utf-32-le")
+    assert got == ol.checkout_tip().snapshot()
