@@ -175,6 +175,73 @@ class DocStore:
                 os.replace(tmp, path)  # atomic
 
 
+def _crdt_next_seq(aa, agent: int) -> int:
+    nxt = 0
+    for (lv0, lv1, ag, seq0) in aa.global_runs:
+        if ag == agent:
+            nxt = max(nxt, seq0 + (lv1 - lv0))
+    return nxt
+
+
+def _crdt_apply_op(ol: OpLog, op: dict) -> None:
+    """Fold one browser-CRDT op (original position + explicit parents)
+    into the oplog; idempotent on (agent, seq) replays. Validation runs
+    BEFORE any mutation: a bad op must not leave a half-appended log."""
+    from operator import index as _ix
+    agent = ol.get_or_create_agent_id(str(op["agent"]))
+    seq = _ix(op["seq"])
+    aa = ol.cg.agent_assignment
+    nxt = _crdt_next_seq(aa, agent)
+    if seq < nxt:
+        return   # already known (client re-push after a dropped response)
+    if seq > nxt:
+        raise ValueError(f"seq gap: client sent {seq}, log expects {nxt}")
+    frontier = list(ol.cg.remote_to_local_frontier(
+        [(str(a), _ix(s)) for (a, s) in op.get("parents") or []]))
+    if op.get("kind") == "ins":
+        ol.add_insert_at(agent, frontier, _ix(op["pos"]),
+                         str(op["content"]))
+    elif op.get("kind") == "del":
+        start = _ix(op["pos"])
+        n = _ix(op["len"])
+        # content=None: deleted text is recoverable from history; a full
+        # checkout per unit delete under store.lock would be O(history)
+        # per character
+        ol.add_delete_at(agent, frontier, start, start + n, None)
+    else:
+        raise ValueError("bad crdt op kind")
+
+
+def _crdt_ops_since(ol: OpLog, have: dict) -> list:
+    """Every op whose (agent, seq) is at or past the client's next-seq
+    map, as per-RUN JSON rows with original positions + remote parents."""
+    from ..text.op import INS
+    aa = ol.cg.agent_assignment
+    g = ol.cg.graph
+    out = []
+    for (lv0, lv1, agent, seq0) in aa.global_runs:
+        name = aa.agent_names[agent]
+        nxt = int(have.get(name, 0))
+        want_from = lv0 + max(0, nxt - seq0)
+        if want_from >= lv1:
+            continue
+        for piece in ol.ops.iter_range((want_from, lv1)):
+            a2, s2 = aa.local_to_agent_version(piece.lv)
+            parents = ol.cg.local_to_remote_frontier(
+                g.parents_at(piece.lv))
+            row = {"agent": aa.agent_names[a2], "seq": s2,
+                   "parents": parents,
+                   "kind": "ins" if piece.kind == INS else "del",
+                   "pos": piece.start, "fwd": bool(piece.fwd)}
+            if piece.kind == INS:
+                row["content"] = ol.ops.get_run_content(piece)
+            else:
+                row["len"] = len(piece)
+            out.append(row)
+    out.sort(key=lambda r: (r["agent"], r["seq"]))
+    return out
+
+
 def doc_history_strip(ol: OpLog, n: int, tip: Optional[list] = None):
     """Up to `n` historical snapshots of `ol` up to the frozen frontier
     `tip`, oldest-first, as [{"lv", "text"}].
@@ -246,16 +313,18 @@ class SyncHandler(BaseHTTPRequestHandler):
         return None, None
 
     def do_GET(self):
-        from .web_assets import EDITOR_HTML, INDEX_HTML, VIS_HTML
+        from .web_assets import (CRDT_HTML, EDITOR_HTML, INDEX_HTML,
+                                 VIS_HTML)
 
         parts = self.path.strip("/").split("/")
         if self.path == "/" or self.path == "":
             return self._send(200, INDEX_HTML.encode("utf8"),
                               "text/html; charset=utf-8")
-        if len(parts) == 2 and parts[0] in ("edit", "vis"):
+        if len(parts) == 2 and parts[0] in ("edit", "vis", "crdt"):
             if not _DOC_ID_RE.match(parts[1]):
                 return self._send(404, b"{}")
-            page = EDITOR_HTML if parts[0] == "edit" else VIS_HTML
+            page = {"edit": EDITOR_HTML, "vis": VIS_HTML,
+                    "crdt": CRDT_HTML}[parts[0]]
             return self._send(200, page.replace("__DOC__", parts[1])
                               .encode("utf8"), "text/html; charset=utf-8")
 
@@ -421,6 +490,36 @@ class SyncHandler(BaseHTTPRequestHandler):
                         return self._send(200,
                                           json.dumps(out).encode("utf8"))
                     c.wait(timeout=min(remaining, 5.0))
+        if action == "ops":
+            # In-browser CRDT peer protocol (reference: the wiki app's
+            # WASM client runs the full CRDT locally,
+            # wiki/client/dt_doc.ts:40-171; here the browser runs a JS
+            # engine — web_assets.CRDT_HTML — and exchanges ORIGINAL
+            # positional ops with explicit parent versions, never
+            # server-transformed positions):
+            #   body {"have": {agent_name: next_seq...},
+            #         "push": [{agent, seq, parents: [[a, s]...], kind,
+            #                   pos, content|len}...]}
+            #   -> {"ops": [...missing ops in the same shape...],
+            #       "version": remote frontier}
+            req = json.loads(body or b"{}")
+            applied = 0
+            try:
+                with self.store.lock:
+                    for op in req.get("push") or []:
+                        _crdt_apply_op(ol, op)
+                        applied += 1
+                    out_ops = _crdt_ops_since(ol, req.get("have") or {})
+                    ver = ol.cg.local_to_remote_frontier(ol.version)
+            finally:
+                if applied:
+                    # ops before a mid-batch failure ARE in the log;
+                    # flusher + long-pollers must see them either way
+                    # (both helpers take store.lock themselves)
+                    self.store.mark_dirty(doc_id)
+                    self.store.notify(doc_id)
+            return self._send(200, json.dumps(
+                {"ops": out_ops, "version": ver}).encode("utf8"))
         if action == "history":
             # Batched time travel: ONE vmapped device call materializes
             # every requested historical snapshot (tpu/plan_kernels.py

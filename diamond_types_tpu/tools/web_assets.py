@@ -26,11 +26,16 @@ INDEX_HTML = """<!doctype html>
  <input id="d" placeholder="doc id" value="note">
  <button>edit</button>
  <button type=button onclick="vis()">visualize</button>
+ <button type=button onclick="crdt()">crdt peer</button>
 </form>
+<p style="font-size:13px;color:#777">"edit" is the positional dumb
+client (server-side OT); "crdt peer" runs the full CRDT in your browser
+— it edits offline and merges locally.</p>
 <script>
  const f=()=>document.getElementById('d').value.trim()||'note';
  function go(){location.href='/edit/'+encodeURIComponent(f())}
  function vis(){location.href='/vis/'+encodeURIComponent(f())}
+ function crdt(){location.href='/crdt/'+encodeURIComponent(f())}
 </script>
 """
 
@@ -243,5 +248,264 @@ fetch(`/doc/${DOC}/graph`).then(r => r.json()).then(g => {
     svg.appendChild(grp);
   });
 });
+</script>
+"""
+
+# In-browser CRDT PEER (reference: wiki/client/dt_doc.ts:40-171 — the
+# wiki app runs the full CRDT in the browser via WASM; this page runs a
+# compact JS engine instead, since wasm bindings are descoped — Python is
+# the binding, SURVEY §7). Unlike EDITOR_HTML's positional "dumb client",
+# this client owns a real oplog: it edits OFFLINE, merges remote ops
+# LOCALLY with the same YjsMod rules as the Python/C++/device engines
+# (integrate, merge.rs:154-278: top-row break / bottom-row skip /
+# same-gap right-origin comparison with the scanning rollback, agent-name
+# then seq tie-break), and exchanges ORIGINAL ops (position + explicit
+# parent versions) with the server — positions are never transformed by
+# the server for this client.
+CRDT_HTML = """<!doctype html>
+<meta charset="utf-8"><title>crdt: __DOC__</title>
+<style>
+ body{font:15px system-ui;margin:2em auto;max-width:52em;color:#222}
+ textarea{width:100%;height:22em;font:14px/1.5 ui-monospace,monospace;
+  padding:1em;border:1px solid #bbb;border-radius:8px;box-sizing:border-box}
+ #st{color:#667;font-size:13px;margin-top:.5em}
+ label{font-size:13px}
+</style>
+<h2>__DOC__ <span style="font-size:13px;color:#888">(in-browser CRDT
+peer)</span></h2>
+<textarea id="t" spellcheck="false"></textarea>
+<div><label><input type="checkbox" id="off"> work offline</label></div>
+<div id="st">starting…</div>
+<script>
+const DOC = "__DOC__";
+const AGENT = "peer-" + Math.random().toString(36).slice(2, 8);
+const ta = document.getElementById("t"), st = document.getElementById("st");
+const offBox = document.getElementById("off");
+
+// ---- the engine: a unit-op text CRDT ---------------------------------
+// ops: [{agent, seq, parents:[[a,s]...], kind:'ins'|'del', pos, ch}]
+// Convergence = the same YjsMod order as every other engine in this
+// repo; replay is O(n^2) full recompute — fine for interactive docs,
+// and it keeps this client auditable against the reference semantics.
+const eng = {
+  ops: [], byKey: new Map(),            // "a:s" -> op index
+  nextSeq: 0, unpushed: 0,              // our own op bookkeeping
+  frontier: [],                         // [[agent, seq]...] local heads
+};
+const K = (a, s) => a + ":" + s;
+
+function addOp(op) {
+  if (eng.byKey.has(K(op.agent, op.seq))) return false;
+  eng.byKey.set(K(op.agent, op.seq), eng.ops.length);
+  eng.ops.push(op);
+  return true;
+}
+
+function localOp(kind, pos, ch) {
+  const op = {agent: AGENT, seq: eng.nextSeq++, parents: eng.frontier,
+              kind, pos, ch};
+  addOp(op);
+  eng.frontier = [[AGENT, op.seq]];
+  eng.unpushed++;
+  return op;
+}
+
+// Replay every op in causal order, maintaining the item list and
+// per-item origins. Ancestor tests use per-op bitsets.
+function replay() {
+  const n = eng.ops.length;
+  const order = [];                      // topological, (agent,seq) ties
+  const indeg = new Array(n).fill(0);
+  const kids = new Map();
+  for (let i = 0; i < n; i++) {
+    for (const [a, s] of eng.ops[i].parents) {
+      const j = eng.byKey.get(K(a, s));
+      if (j === undefined) return null;  // missing dependency: wait
+      indeg[i]++;
+      (kids.get(j) || kids.set(j, []).get(j)).push(i);
+    }
+  }
+  const ready = [];
+  for (let i = 0; i < n; i++) if (!indeg[i]) ready.push(i);
+  const cmp = (x, y) => eng.ops[x].agent < eng.ops[y].agent ? -1 :
+    eng.ops[x].agent > eng.ops[y].agent ? 1 :
+    eng.ops[x].seq - eng.ops[y].seq;
+  while (ready.length) {
+    ready.sort(cmp);
+    const i = ready.shift();
+    order.push(i);
+    for (const k of kids.get(i) || []) if (!--indeg[k]) ready.push(k);
+  }
+  if (order.length !== n) return null;   // cycle = corrupt input
+
+  const W = Math.ceil(n / 32) || 1;
+  const anc = new Uint32Array(n * W);    // anc[i] ⊇ parents ∪ their anc
+  const bit = (row, j) => (anc[row * W + (j >> 5)] >>> (j & 31)) & 1;
+  for (const i of order) {
+    for (const [a, s] of eng.ops[i].parents) {
+      const j = eng.byKey.get(K(a, s));
+      for (let w = 0; w < W; w++) anc[i * W + w] |= anc[j * W + w];
+      anc[i * W + (j >> 5)] |= (1 << (j & 31));
+    }
+  }
+
+  // items: one per insert op, in document order as built
+  const items = [];                      // {ins, dels:[], ol, orr, a, s, ch}
+  const inAnc = (i, item) => bit(i, item.ins) === 1;
+  const visibleAt = (i, item) => inAnc(i, item) &&
+    !item.dels.some(d => bit(i, d));
+
+  for (const i of order) {
+    const op = eng.ops[i];
+    if (op.kind === "del") {
+      let seen = 0;
+      for (const it of items) {
+        if (visibleAt(i, it) && seen++ === op.pos) { it.dels.push(i); break; }
+      }
+      continue;
+    }
+    // insert: origin-left = visible item at pos-1; cursor after it
+    let olIdx = -1, seen = 0;
+    if (op.pos > 0) {
+      for (let x = 0; x < items.length; x++) {
+        if (visibleAt(i, items[x]) && ++seen === op.pos) { olIdx = x; break; }
+      }
+    }
+    // origin-right: first non-NotInsertedYet item after the cursor
+    // (merge.rs:407-424 — deleted items count, concurrent ones don't)
+    let orrIdx = items.length;
+    for (let x = olIdx + 1; x < items.length; x++) {
+      if (inAnc(i, items[x])) { orrIdx = x; break; }
+    }
+    // integrate (YjsMod, merge.rs:154-278) — the scanning state machine
+    let dst = olIdx + 1, scanning = false, scanStart = olIdx + 1;
+    for (let x = olIdx + 1; x < orrIdx; x++) {
+      const o = items[x];
+      const oOl = o.ol, myOl = olIdx;
+      if (oOl < myOl) break;
+      if (oOl === myOl) {
+        if (o.orrKey === (orrIdx < items.length ?
+                          K(items[orrIdx].a, items[orrIdx].s) : "END")) {
+          const ins_here = op.agent < o.a ||
+            (op.agent === o.a && op.seq < o.s);
+          if (ins_here) break;
+          scanning = false;
+        } else {
+          // right-origin document position comparison: o's origin-right
+          // item index vs ours (END compares as farthest)
+          const oR = o.orrItem === -1 ? Infinity : o.orrItem;
+          const myR = orrIdx >= items.length ? Infinity : orrIdx;
+          // rollback lands BEFORE this item (merge.rs:233 clones the
+          // cursor before advancing past it)
+          if (oR < myR) { if (!scanning) { scanning = true; scanStart = x; } }
+          else scanning = false;
+        }
+      }
+      dst = x + 1;
+    }
+    if (scanning) dst = scanStart;
+    const item = {ins: i, dels: [], ol: olIdx, a: op.agent, s: op.seq,
+                  ch: op.ch,
+                  orrItem: orrIdx >= items.length ? -1 : orrIdx,
+                  orrKey: orrIdx < items.length ?
+                    K(items[orrIdx].a, items[orrIdx].s) : "END"};
+    // inserting shifts stored item indexes at/after dst
+    for (const it of items) {
+      if (it.ol >= dst) it.ol++;
+      if (it.orrItem !== -1 && it.orrItem >= dst) it.orrItem++;
+    }
+    if (item.ol >= dst) item.ol++;
+    if (item.orrItem !== -1 && item.orrItem >= dst) item.orrItem++;
+    items.splice(dst, 0, item);
+  }
+  let text = "";
+  for (const it of items) if (!it.dels.length) text += it.ch;
+  return text;
+}
+
+// ---- UI + sync --------------------------------------------------------
+let shadow = "";
+
+function onInput() {
+  const now = ta.value;
+  if (now === shadow) return;
+  let p = 0, oe = shadow.length, ne = now.length;
+  while (p < oe && p < ne && shadow[p] === now[p]) p++;
+  while (oe > p && ne > p && shadow[oe - 1] === now[ne - 1]) { oe--; ne--; }
+  // unit deletes: removing [p, oe) one char at a time — each removal
+  // shifts the next target into position p, so every unit deletes at p
+  for (let x = p; x < oe; x++) localOp("del", p, null);
+  for (let x = p; x < ne; x++) localOp("ins", x, now[x]);
+  shadow = now;
+  st.textContent = "local edit (" + eng.unpushed + " unsynced)";
+}
+
+function rerender() {
+  const text = replay();
+  if (text === null) return;
+  const cur = ta.selectionStart;
+  shadow = text;
+  if (ta.value !== text) {
+    ta.value = text;
+    ta.setSelectionRange(cur, cur);
+  }
+}
+
+async function syncOnce() {
+  if (offBox.checked) return;
+  const have = {};
+  for (const op of eng.ops) {
+    have[op.agent] = Math.max(have[op.agent] || 0, op.seq + 1);
+  }
+  const push = [];
+  for (const op of eng.ops) {
+    if (op.agent === AGENT && op.seq >= eng.nextSeq - eng.unpushed) {
+      push.push({agent: op.agent, seq: op.seq, parents: op.parents,
+                 kind: op.kind, pos: op.pos,
+                 ...(op.kind === "ins" ? {content: op.ch} : {len: 1})});
+    }
+  }
+  try {
+    const r = await fetch(`/doc/${DOC}/ops`, {method: "POST",
+      body: JSON.stringify({have, push})}).then(r => r.json());
+    // ops typed while the request was in flight incremented unpushed
+    // AFTER `push` was built — subtract only what this round sent, or
+    // the in-flight edits would be orphaned forever
+    eng.unpushed -= push.length;
+    let fresh = 0;
+    for (const row of r.ops) {
+      // expand run rows into unit ops (chained parents within the run)
+      const units = row.kind === "ins" ? row.content.length : row.len;
+      for (let u = 0; u < units; u++) {
+        // fwd deletes repeat at the span start (each removal shifts the
+        // next char in); reverse (backspace) runs walk end-1 downward
+        const dpos = row.fwd ? row.pos : row.pos + (units - 1 - u);
+        const op = {agent: row.agent, seq: row.seq + u,
+          parents: u === 0 ? row.parents : [[row.agent, row.seq + u - 1]],
+          kind: row.kind,
+          pos: row.kind === "ins" ? row.pos + u : dpos,
+          ch: row.kind === "ins" ? row.content[u] : null};
+        if (addOp(op)) fresh++;
+      }
+    }
+    if (fresh) {
+      // remote heads join our frontier
+      const f = new Map(eng.frontier.map(([a, s]) => [a, s]));
+      for (const [a, s] of r.version) {
+        if (a !== AGENT) f.set(a, Math.max(f.get(a) ?? -1, s));
+      }
+      eng.frontier = [...f.entries()];
+      rerender();
+    }
+    st.textContent = `synced · ${eng.ops.length} ops · ` +
+      (offBox.checked ? "offline" : "online");
+  } catch (e) {
+    st.textContent = "sync failed: " + e;
+  }
+}
+
+ta.addEventListener("input", onInput);
+setInterval(syncOnce, 1200);
+syncOnce().then(rerender);
 </script>
 """

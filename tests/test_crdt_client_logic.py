@@ -1,0 +1,194 @@
+"""Differential test of the in-browser CRDT engine's ALGORITHM.
+
+No JS runtime exists in this image, so `_replay_mirror` below is a
+line-faithful Python transliteration of web_assets.CRDT_HTML's replay()
+(same structure: topological order with (agent, seq) ties, ancestor
+sets, origin resolution, the YjsMod integrate state machine with the
+scanning rollback). Fuzzing it against the real oplog engines validates
+the browser algorithm; keep the two in sync when editing either.
+"""
+
+import random
+
+import pytest
+
+from diamond_types_tpu import OpLog
+from diamond_types_tpu.tools.server import _crdt_apply_op
+
+
+def _replay_mirror(ops):
+    by_key = {(o["agent"], o["seq"]): i for i, o in enumerate(ops)}
+    n = len(ops)
+    # topological order, ready set sorted by (agent, seq)
+    indeg = [0] * n
+    kids = {}
+    for i, o in enumerate(ops):
+        for (a, s) in o["parents"]:
+            j = by_key[(a, s)]
+            indeg[i] += 1
+            kids.setdefault(j, []).append(i)
+    ready = sorted((i for i in range(n) if not indeg[i]),
+                   key=lambda i: (ops[i]["agent"], ops[i]["seq"]))
+    order = []
+    while ready:
+        ready.sort(key=lambda i: (ops[i]["agent"], ops[i]["seq"]))
+        i = ready.pop(0)
+        order.append(i)
+        for k in kids.get(i, ()):
+            indeg[k] -= 1
+            if not indeg[k]:
+                ready.append(k)
+    assert len(order) == n
+
+    anc = [set() for _ in range(n)]
+    for i in order:
+        for (a, s) in ops[i]["parents"]:
+            j = by_key[(a, s)]
+            anc[i] |= anc[j]
+            anc[i].add(j)
+
+    items = []   # dicts: ins, dels, ol, a, s, ch, orrItem, orrKey
+
+    def in_anc(i, it):
+        return it["ins"] in anc[i]
+
+    def visible_at(i, it):
+        return in_anc(i, it) and not any(d in anc[i] for d in it["dels"])
+
+    for i in order:
+        op = ops[i]
+        if op["kind"] == "del":
+            seen = 0
+            for it in items:
+                if visible_at(i, it):
+                    if seen == op["pos"]:
+                        it["dels"].append(i)
+                        break
+                    seen += 1
+            continue
+        ol_idx, seen = -1, 0
+        if op["pos"] > 0:
+            for x, it in enumerate(items):
+                if visible_at(i, it):
+                    seen += 1
+                    if seen == op["pos"]:
+                        ol_idx = x
+                        break
+        orr_idx = len(items)
+        for x in range(ol_idx + 1, len(items)):
+            if in_anc(i, items[x]):
+                orr_idx = x
+                break
+        dst, scanning, scan_start = ol_idx + 1, False, ol_idx + 1
+        my_orr_key = ((items[orr_idx]["a"], items[orr_idx]["s"])
+                      if orr_idx < len(items) else "END")
+        for x in range(ol_idx + 1, orr_idx):
+            o = items[x]
+            if o["ol"] < ol_idx:
+                break
+            if o["ol"] == ol_idx:
+                if o["orrKey"] == my_orr_key:
+                    ins_here = (op["agent"], op["seq"]) < (o["a"], o["s"])
+                    if ins_here:
+                        break
+                    scanning = False
+                else:
+                    o_r = float("inf") if o["orrItem"] == -1 else o["orrItem"]
+                    my_r = float("inf") if orr_idx >= len(items) else orr_idx
+                    if o_r < my_r:
+                        # rollback lands BEFORE this item (merge.rs:233
+                        # clones the cursor before advancing past it)
+                        if not scanning:
+                            scanning, scan_start = True, x
+                    else:
+                        scanning = False
+            dst = x + 1
+        if scanning:
+            dst = scan_start
+        item = {"ins": i, "dels": [], "ol": ol_idx, "a": op["agent"],
+                "s": op["seq"], "ch": op["ch"],
+                "orrItem": -1 if orr_idx >= len(items) else orr_idx,
+                "orrKey": my_orr_key}
+        for it in items:
+            if it["ol"] >= dst:
+                it["ol"] += 1
+            if it["orrItem"] != -1 and it["orrItem"] >= dst:
+                it["orrItem"] += 1
+        if item["ol"] >= dst:
+            item["ol"] += 1
+        if item["orrItem"] != -1 and item["orrItem"] >= dst:
+            item["orrItem"] += 1
+        items.insert(dst, item)
+    return "".join(it["ch"] for it in items if not it["dels"])
+
+
+def _oracle_text(ops):
+    ol = OpLog()
+    # feed in topo order (the server would receive them causally too)
+    by_key = {(o["agent"], o["seq"]): o for o in ops}
+    done = set()
+    rest = list(ops)
+    while rest:
+        progressed = False
+        nxt = []
+        for o in sorted(rest, key=lambda o: (o["agent"], o["seq"])):
+            if all((a, s) in done for (a, s) in o["parents"]):
+                row = {"agent": o["agent"], "seq": o["seq"],
+                       "parents": o["parents"], "kind": o["kind"],
+                       "pos": o["pos"]}
+                if o["kind"] == "ins":
+                    row["content"] = o["ch"]
+                else:
+                    row["len"] = 1
+                _crdt_apply_op(ol, row)
+                done.add((o["agent"], o["seq"]))
+                progressed = True
+            else:
+                nxt.append(o)
+        assert progressed
+        rest = nxt
+    return ol.checkout_tip().snapshot()
+
+
+ALPHABET = "abcdefgh XY12"
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_browser_engine_vs_oracle(seed):
+    """Random concurrent unit-op histories: the browser replay algorithm
+    must converge to EXACTLY the oplog engines' text."""
+    rng = random.Random(4400 + seed)
+    agents = ["anna", "bert", "cleo"]
+    ops = []
+    heads = {}     # agent -> (frontier, text)
+    shared_frontier, shared_text = [], ""
+    for a in agents:
+        heads[a] = ([], "")
+    for step in range(40):
+        a = agents[rng.randrange(3)]
+        frontier, text = heads[a]
+        seq = sum(1 for o in ops if o["agent"] == a)
+        if not text or rng.random() < 0.7:
+            pos = rng.randint(0, len(text))
+            ch = rng.choice(ALPHABET)
+            ops.append({"agent": a, "seq": seq, "parents": frontier,
+                        "kind": "ins", "pos": pos, "ch": ch})
+            text = text[:pos] + ch + text[pos:]
+        else:
+            pos = rng.randrange(len(text))
+            ops.append({"agent": a, "seq": seq, "parents": frontier,
+                        "kind": "del", "pos": pos, "ch": None})
+            text = text[:pos] + text[pos + 1:]
+        heads[a] = ([[a, seq]], text)
+        if rng.random() < 0.3:
+            # peer pulls everything known so far (frontier = all heads)
+            f = []
+            for a2 in agents:
+                s2 = sum(1 for o in ops if o["agent"] == a2)
+                if s2:
+                    f.append([a2, s2 - 1])
+            merged = _replay_mirror(ops)
+            heads[a] = (f, merged)
+    got = _replay_mirror(ops)
+    exp = _oracle_text(ops)
+    assert got == exp, f"seed {seed}: {got!r} != {exp!r}"

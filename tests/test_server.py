@@ -366,3 +366,132 @@ def test_history_strip_host_path():
     assert len(snaps) >= 2
     assert snaps[-1]["text"] == ol.checkout_tip().snapshot()
     assert [s["lv"] for s in snaps] == sorted(s["lv"] for s in snaps)
+
+
+class _CrdtPeer:
+    """A minimal Python twin of the in-browser CRDT peer (web_assets.
+    CRDT_HTML): pushes ORIGINAL unit ops with explicit parent versions,
+    pulls missing ops by summary. Exercises /doc/{id}/ops end to end."""
+
+    def __init__(self, base, doc, name):
+        import urllib.request
+        self._rq = urllib.request
+        self.base, self.doc, self.name = base, doc, name
+        self.seq = 0
+        self.frontier = []         # [[agent, seq]...]
+        self.pending = []
+        self.known = {}            # agent -> next seq
+
+    def edit_ins(self, pos, text):
+        for i, ch in enumerate(text):
+            op = {"agent": self.name, "seq": self.seq,
+                  "parents": self.frontier, "kind": "ins",
+                  "pos": pos + i, "content": ch}
+            self.frontier = [[self.name, self.seq]]
+            self.seq += 1
+            self.pending.append(op)
+        self.known[self.name] = self.seq
+
+    def edit_del(self, pos, n):
+        for _ in range(n):
+            op = {"agent": self.name, "seq": self.seq,
+                  "parents": self.frontier, "kind": "del",
+                  "pos": pos, "len": 1}
+            self.frontier = [[self.name, self.seq]]
+            self.seq += 1
+            self.pending.append(op)
+        self.known[self.name] = self.seq
+
+    def sync(self):
+        import json
+        body = json.dumps({"have": self.known, "push": self.pending})
+        req = self._rq.Request(f"{self.base}/doc/{self.doc}/ops",
+                               data=body.encode("utf8"))
+        out = json.loads(self._rq.urlopen(req).read())
+        self.pending = []
+        for row in out["ops"]:
+            units = len(row.get("content") or "") if row["kind"] == "ins" \
+                else row["len"]
+            nxt = self.known.get(row["agent"], 0)
+            self.known[row["agent"]] = max(nxt, row["seq"] + units)
+        f = {a: s for a, s in self.frontier}
+        for a, s in out["version"]:
+            if a != self.name:
+                f[a] = max(f.get(a, -1), s)
+        self.frontier = [[a, s] for a, s in f.items()]
+        return out
+
+
+def _boot_server(tmp_path=None):
+    import threading
+    from diamond_types_tpu.tools.server import serve
+    srv = serve(port=0, data_dir=None)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+def test_crdt_peer_protocol_concurrent():
+    """Two peers edit OFFLINE from a shared version, then sync: the
+    server folds their original ops through the CRDT; pulled rows carry
+    explicit parents so a browser engine can merge locally."""
+    srv, base = _boot_server()
+    try:
+        p1 = _CrdtPeer(base, "cdoc", "anna")
+        p2 = _CrdtPeer(base, "cdoc", "bert")
+        p1.edit_ins(0, "hello world")
+        p1.sync()
+        p2.sync()                      # bert pulls anna's ops
+        # both edit concurrently (offline) at the same gap
+        p1.edit_ins(5, "-A")
+        p2.edit_ins(5, "-B")
+        p1.edit_del(0, 1)              # anna also deletes 'h'
+        p1.sync()
+        p2.sync()
+        p1.sync()
+        # server text is the converged CRDT result
+        store = srv.RequestHandlerClass.store
+        ol = store.get("cdoc")
+        text = ol.checkout_tip().snapshot()
+        assert "-A" in text and "-B" in text
+        assert text.startswith("ello") and text.endswith("world")
+        # a fresh peer pulling everything sees rows that rebuild the doc
+        p3 = _CrdtPeer(base, "cdoc", "cara")
+        out = p3.sync()
+        total_units = sum(len(r.get("content") or "") if r["kind"] == "ins"
+                          else r["len"] for r in out["ops"])
+        assert total_units == len(ol)
+        # idempotent re-push: replaying anna's first op is a no-op
+        p4 = _CrdtPeer(base, "cdoc", "anna")
+        p4.seq = 0
+        p4.edit_ins(0, "h")            # same (anna, 0) id
+        p4.pending[0]["parents"] = []
+        p4.sync()
+        assert ol.checkout_tip().snapshot() == text
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_crdt_peer_offline_convergence_order_free():
+    """Sync order must not matter (op exchange is causal + idempotent)."""
+    srv, base = _boot_server()
+    try:
+        a = _CrdtPeer(base, "odoc", "aa")
+        b = _CrdtPeer(base, "odoc", "bb")
+        a.edit_ins(0, "base ")
+        a.sync()
+        b.sync()
+        a.edit_ins(5, "AAA")
+        b.edit_ins(5, "BBB")
+        b.sync()                       # reversed order vs previous test
+        a.sync()
+        b.sync()
+        store = srv.RequestHandlerClass.store
+        text = store.get("odoc").checkout_tip().snapshot()
+        assert text == "base AAABBB" or text == "base BBBAAA"
+        # deterministic: agent 'aa' < 'bb' -> AAA first
+        assert text == "base AAABBB"
+    finally:
+        srv.shutdown()
+        srv.server_close()
