@@ -19,8 +19,13 @@ def build(force: bool = False) -> str | None:
     if not force and os.path.exists(OUT) and \
             all(os.path.getmtime(OUT) >= os.path.getmtime(s) for s in srcs):
         return OUT
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-DNDEBUG", *srcs, "-o", OUT]
+    # -fno-semantic-interposition: lets the compiler inline across
+    # functions inside the DSO despite -fPIC (ELF interposition rules
+    # otherwise force calls through the PLT); ~14% on the git-makefile
+    # merge in interleaved A/B runs. (-flto HURTS the shared build —
+    # measured 20% slower — even though it helps the static bench binary.)
+    cmd = ["g++", "-O3", "-march=native", "-fno-semantic-interposition",
+           "-std=c++17", "-shared", "-fPIC", "-DNDEBUG", *srcs, "-o", OUT]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except (subprocess.CalledProcessError, FileNotFoundError) as e:
