@@ -80,7 +80,9 @@ class _P:
 
 
 def _upd(n: _P) -> None:
-    s = n.own_alive
+    # hot path (called along the root path for every mutation): inline
+    # the own-alive term rather than paying a property call
+    s = n.length if n.alive else 0
     if n.left is not None:
         s += n.left.sub_alive
     if n.right is not None:
@@ -348,62 +350,68 @@ class EntryComposer:
         out.del_base = self.del_base
         out.del_own = self.del_own
 
-        # walk the table in order, collecting own chars grouped by their
-        # block ids; intra-block order IS table order
-        per_block: Dict[int, List[Tuple[int, int]]] = {}
+        # walk the table in order, collecting own PIECES grouped by their
+        # block ids; intra-block order IS table order (char columns are
+        # expanded vectorized below — per-char Python tuples were the
+        # composition profile's second-hottest line)
+        per_block: Dict[int, List[Tuple[int, int, int]]] = {}
         for p in self._in_order():
             if p.base >= 0:
                 continue
             blk = self.heads[p.head].block
-            lst = per_block.setdefault(blk, [])
-            lst.extend((lv, p.head) for lv in range(p.lv, p.lv + p.length))
+            per_block.setdefault(blk, []).append((p.lv, p.length, p.head))
 
-        ch_lv: List[int] = []
-        ch_block: List[int] = []
-        ch_head: List[int] = []
-        ch_kind: List[int] = []
-        ch_anchor: List[int] = []
-        ch_q: List[int] = []
-        ch_headlv: List[int] = []
-        ch_orrown: List[int] = []
+        # per-piece rows, then one vectorized char expansion
+        p_lv: List[int] = []
+        p_len: List[int] = []
+        p_blk: List[int] = []
+        p_headlv: List[int] = []
+        p_orrown: List[int] = []
         blk_start: List[int] = []
         blk_len: List[int] = []
         blk_root_q: List[int] = []
         blk_root_lv: List[int] = []
+        total = 0
         for blk in sorted(per_block):
-            lvs = per_block[blk]
-            blk_start.append(len(ch_lv))
-            blk_len.append(len(lvs))
+            pieces = per_block[blk]
+            blk_start.append(total)
+            blk_len.append(sum(ln for _, ln, _ in pieces))
+            total += blk_len[-1]
             root_lv = self.blk_root_lv[blk]
             blk_root_q.append(self.heads[root_lv].q)
             blk_root_lv.append(root_lv)
             bi = len(blk_start) - 1
-            for lv, head_lv in lvs:
-                meta = self.heads.get(lv) if lv == head_lv else None
-                head_meta = self.heads[head_lv]
-                ch_lv.append(lv)
-                ch_block.append(bi)
-                ch_headlv.append(head_lv)
-                ch_orrown.append(head_meta.orr_own)
-                if meta is not None:
-                    ch_head.append(1)
-                    ch_kind.append(meta.kind)
-                    ch_anchor.append(meta.anchor_lv)
-                    ch_q.append(meta.q)
-                else:
-                    ch_head.append(0)
-                    ch_kind.append(0)
-                    ch_anchor.append(-1)
-                    ch_q.append(-1)
+            for (lv, ln, head_lv) in pieces:
+                p_lv.append(lv)
+                p_len.append(ln)
+                p_blk.append(bi)
+                p_headlv.append(head_lv)
+                p_orrown.append(self.heads[head_lv].orr_own)
 
-        out.ch_lv = np.asarray(ch_lv, dtype=np.int64)
-        out.ch_block = np.asarray(ch_block, dtype=np.int32)
-        out.ch_head = np.asarray(ch_head, dtype=np.int8)
-        out.ch_kind = np.asarray(ch_kind, dtype=np.int8)
-        out.ch_anchor = np.asarray(ch_anchor, dtype=np.int64)
-        out.ch_q = np.asarray(ch_q, dtype=np.int32)
-        out.ch_headlv = np.asarray(ch_headlv, dtype=np.int64)
-        out.ch_orrown = np.asarray(ch_orrown, dtype=np.int64)
+        plv = np.asarray(p_lv, dtype=np.int64)
+        plen = np.asarray(p_len, dtype=np.int64)
+        rep = np.repeat(np.arange(len(plv)), plen)
+        cum = np.concatenate([[0], np.cumsum(plen)])[:-1]
+        off = np.arange(total, dtype=np.int64) - cum[rep]
+        out.ch_lv = plv[rep] + off
+        out.ch_block = np.asarray(p_blk, dtype=np.int32)[rep]
+        out.ch_headlv = np.asarray(p_headlv, dtype=np.int64)[rep]
+        out.ch_orrown = np.asarray(p_orrown, dtype=np.int64)[rep]
+        # head flags/metadata: a char is a run head iff its lv IS the
+        # piece's governing head lv (splits never create heads)
+        is_head = out.ch_lv == out.ch_headlv
+        out.ch_head = is_head.astype(np.int8)
+        kind = np.zeros(total, dtype=np.int8)
+        anchor = np.full(total, -1, dtype=np.int64)
+        qq = np.full(total, -1, dtype=np.int32)
+        for i in np.flatnonzero(is_head):
+            meta = self.heads[int(out.ch_lv[i])]
+            kind[i] = meta.kind
+            anchor[i] = meta.anchor_lv
+            qq[i] = meta.q
+        out.ch_kind = kind
+        out.ch_anchor = anchor
+        out.ch_q = qq
         out.blk_root_q = np.asarray(blk_root_q, dtype=np.int32)
         out.blk_root_lv = np.asarray(blk_root_lv, dtype=np.int64)
         out.blk_start = np.asarray(blk_start, dtype=np.int32)
