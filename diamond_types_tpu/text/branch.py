@@ -82,6 +82,9 @@ class Branch:
             linearization of the conflict zone, batched-friendly),
           * default — C++ host core when built (same algorithm as the
             Python engine, ~2 orders of magnitude faster),
+          * DT_TPU_ZONE=1 — zone engine (host composes entries, every
+            origin resolves against state rows on the device tier —
+            tpu/zone_kernel.py; the round-3 flagship),
           * DT_TPU_PLAN2=1 — fork/join plan engine (compile the conflict
             zone into a Begin/Fork/Max/Apply schedule over numbered state
             indexes, execute against the dense state matrix — the
@@ -102,6 +105,16 @@ class Branch:
                                           merge_frontier)
             self.content = Rope(text)
             self.version = frontier
+            return
+        if os.environ.get("DT_TPU_ZONE"):
+            # the round-3 zone engine: host composes, device (or the
+            # NumPy oracle under JAX_PLATFORMS=cpu) resolves every origin
+            # against state rows — no tracker anywhere
+            from ..tpu.zone_kernel import zone_checkout_device
+            text, frontier = zone_checkout_device(oplog, self.version,
+                                                  merge_frontier)
+            self.content = Rope(text)
+            self.version = list(frontier)
             return
         if not os.environ.get("DT_TPU_NO_NATIVE"):
             from ..native import merge_native, native_available

@@ -93,3 +93,28 @@ def test_zone_kernel_big_corpora(corpus):
         ol = load_oplog(f.read())
     txt, _ = zone_checkout_device(ol)
     assert txt == ol.checkout_tip().snapshot()
+
+
+def test_zone_engine_behind_branch_merge(monkeypatch):
+    """DT_TPU_ZONE=1 selects the zone engine behind the same
+    Branch.merge boundary as every other engine."""
+    import random
+    from diamond_types_tpu import OpLog
+    rng = random.Random(99)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("za", "zb")]
+    branches = [([], "")]
+    for _ in range(30):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        version, content = random_edit(rng, ol, agents[rng.randrange(2)],
+                                       version, content)
+        if rng.random() < 0.3 and len(branches) < 4:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    expected = ol.checkout_tip().snapshot()
+    monkeypatch.setenv("DT_TPU_ZONE", "1")
+    b = ol.checkout_tip()
+    assert b.snapshot() == expected
+    assert sorted(b.version) == sorted(ol.version)
