@@ -44,6 +44,7 @@ PAGE_SIZE = 4096
 _HDR = struct.Struct("<IBBHIII")        # crc, stream, blit, used, idx, gen, seq
 PAYLOAD = PAGE_SIZE - _HDR.size
 _REC = struct.Struct("<I")               # record length frame
+_DEAD = 255      # reserved stream id: page invalidated by a rollback
 
 
 class PageStoreError(Exception):
@@ -111,23 +112,25 @@ class PagedStore:
     def _recover(self) -> None:
         n_pages = (os.path.getsize(self.path) + PAGE_SIZE - 1) // PAGE_SIZE
         self._next_free = n_pages
-        # (stream, idx) -> best image (gen, seq, payload) and best MAIN
-        # slot per key; blit slot per stream (the newest blit page wins)
-        best: Dict[Tuple[int, int], Tuple[int, int, bytes]] = {}
+        # (stream, idx) -> best image (gen, seq, payload, is_blit) and best
+        # MAIN slot per key; blit slot per stream (the newest blit wins)
+        best: Dict[Tuple[int, int], Tuple[int, int, bytes, int]] = {}
         main_slot: Dict[Tuple[int, int, int], int] = {}  # (stream,gen,idx)
-        blit: Dict[int, Tuple[int, int]] = {}            # stream -> (seq,slot)
+        blit: Dict[int, Tuple[int, int, int, int]] = {}  # s->(seq,slot,idx,gen)
         max_seq: Dict[Tuple[int, int], int] = {}         # (stream,gen)
         for slot in range(n_pages):
             p = self._read_page(slot)
             if p is None:
                 continue
             stream, is_blit, used, idx, gen, seq, payload = p
+            if stream == _DEAD:
+                continue   # invalidated by an earlier rollback
             k = (stream, gen)
             max_seq[k] = max(max_seq.get(k, 0), seq)
             if is_blit:
                 cur = blit.get(stream)
                 if cur is None or seq >= cur[0]:
-                    blit[stream] = (seq, slot)
+                    blit[stream] = (seq, slot, idx, gen)
             else:
                 key = (stream, gen, idx)
                 cur = main_slot.get(key)
@@ -136,18 +139,18 @@ class PagedStore:
             key2 = (stream, idx)
             cur2 = best.get(key2)
             if cur2 is None or (gen, seq) > (cur2[0], cur2[1]):
-                best[key2] = (gen, seq, payload)
+                best[key2] = (gen, seq, payload, is_blit)
         # live chain per stream = highest gen seen at idx 0; ALSO track
         # the max gen seen anywhere so a stream recreated after losing
         # its idx-0 page can never splice stale same-gen pages back in
         live_gen: Dict[int, int] = {}
-        for (stream, idx), (gen, _s, _p) in best.items():
+        for (stream, idx), (gen, _s, _p, _b) in best.items():
             if idx == 0:
                 live_gen[stream] = max(live_gen.get(stream, -1), gen)
             self._max_gen[stream] = max(self._max_gen.get(stream, 0), gen)
         for stream, gen in live_gen.items():
             ch = _Chain(gen)
-            ch.blit_slot = blit.get(stream, (0, None))[1]
+            ch.blit_slot = blit.get(stream, (0, None, 0, 0))[1]
             payloads: List[bytes] = []
             idx = 0
             while True:
@@ -178,7 +181,7 @@ class PagedStore:
             for i in range(n_full):
                 entry = main_slot.get((stream, gen, i))
                 content = buf[i * PAYLOAD:(i + 1) * PAYLOAD]
-                bgen, bseq, bpayload = best[(stream, i)]
+                bseq = best[(stream, i)][1]
                 if entry is None or entry[0] < bseq:
                     # the newest image of this finalized page lives on the
                     # blit slot (tail filled on an odd write) — re-seal it
@@ -194,11 +197,36 @@ class PagedStore:
             ch.tail_data = buf[n_full * PAYLOAD:]
             tm = main_slot.get((stream, gen, n_full))
             ch.tail_main = None if tm is None else tm[1]
-            # new tail writes must outrank ANY stale image of this chain
-            # (a torn-record rollback can re-point the tail at a page
-            # whose on-disk image carries a higher seq; ditto re-sealed
-            # finalized pages above)
-            ch.tail_seq = seal_seq
+            # Invalidate the rolled-back suffix: a torn-record rollback can
+            # shrink the chain, leaving VALID same-gen pages past the new
+            # tail on disk. Without killing them, a later recovery's chain
+            # walk splices their bytes back into the record stream (after a
+            # clean intervening close), yielding phantom/garbage records.
+            for key in [k for k in main_slot
+                        if k[0] == stream and k[1] == gen and k[2] > n_full]:
+                self._write_page(main_slot[key][1], _DEAD, 0, 0, 0, 0, 0,
+                                 b"")
+                del main_slot[key]
+            bl = blit.get(stream)
+            if bl is not None and bl[3] == gen and bl[2] > n_full:
+                # Stale high-idx tail image on the blit slot: overwrite it
+                # with a valid EMPTY blit image at the new tail idx (not a
+                # _DEAD page — the next recovery must still recognize the
+                # slot as this stream's blit, or it would be leaked and a
+                # fresh slot allocated per rollback+reopen). seq 0 loses to
+                # any real tail image at this idx.
+                self._write_page(bl[1], stream, 1, 0, n_full, gen, 0, b"")
+            # New tail writes must outrank ANY stale image of this chain
+            # (rollback can re-point the tail at a page whose on-disk image
+            # carries a higher seq; ditto re-sealed pages above). Parity
+            # matters too: tail writes alternate main/blit by seq, and the
+            # FIRST post-recovery write must target the slot NOT holding
+            # the newest tail image, or a torn write there could destroy
+            # the only valid copy of committed records.
+            tb = best.get((stream, n_full))
+            tail_on_blit = bool(tb is not None and tb[0] == gen and tb[3])
+            want = 1 if tail_on_blit else 0   # next write flips parity
+            ch.tail_seq = seal_seq if seal_seq % 2 == want else seal_seq + 1
             self._chains[stream] = ch
 
     # ---- write path ------------------------------------------------------
@@ -254,6 +282,8 @@ class PagedStore:
     def append(self, stream: int, record: bytes) -> None:
         """Append one length-framed record (may span pages). Each touched
         page costs exactly one page write + fsync."""
+        if stream == _DEAD:   # recovery would skip its pages as garbage
+            raise PageStoreError("stream id 255 is reserved")
         ch = self._chain(stream)
         data = _REC.pack(len(record)) + record
         while True:
@@ -268,6 +298,8 @@ class PagedStore:
     def reset_stream(self, stream: int) -> None:
         """Start a fresh (empty) chain generation for the stream; prior
         pages become garbage until the file is compacted."""
+        if stream == _DEAD:
+            raise PageStoreError("stream id 255 is reserved")
         old = self._chains.get(stream)
         gen = self._max_gen.get(stream, -1) + 1
         self._max_gen[stream] = gen

@@ -183,33 +183,75 @@ def _crdt_next_seq(aa, agent: int) -> int:
     return nxt
 
 
-def _crdt_apply_op(ol: OpLog, op: dict) -> None:
+def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     """Fold one browser-CRDT op (original position + explicit parents)
     into the oplog; idempotent on (agent, seq) replays. Validation runs
-    BEFORE any mutation: a bad op must not leave a half-appended log."""
+    BEFORE any mutation: a bad op must not leave a half-appended log.
+
+    `cache` (shared across one batch) carries (frontier, doc-length) from
+    the previous op: client batches are almost always a linear chain
+    (each op's parents = the previous op's result), so only the first op
+    pays a full checkout — without it a reconnect pushing hundreds of
+    queued ops would run O(ops x history) Branch merges under
+    store.lock, stalling every other endpoint."""
     from operator import index as _ix
-    agent = ol.get_or_create_agent_id(str(op["agent"]))
+    name = str(op["agent"])
     seq = _ix(op["seq"])
     aa = ol.cg.agent_assignment
-    nxt = _crdt_next_seq(aa, agent)
+    # Resolve WITHOUT creating: a rejected op must not leave the agent
+    # name registered (rejected-only traffic would otherwise grow the
+    # agent table without bound, and the junk names get persisted by the
+    # next legitimate flush). The agent is created only at mutation time.
+    agent = aa.agent_names.index(name) if name in aa.agent_names else None
+    nxt = 0 if agent is None else _crdt_next_seq(aa, agent)
     if seq < nxt:
         return   # already known (client re-push after a dropped response)
     if seq > nxt:
         raise ValueError(f"seq gap: client sent {seq}, log expects {nxt}")
     frontier = list(ol.cg.remote_to_local_frontier(
         [(str(a), _ix(s)) for (a, s) in op.get("parents") or []]))
+    # Clients track their frontier as a per-agent max-seq map, so pushed
+    # parents may contain dominated heads; store the minimal frontier the
+    # rest of the codebase assumes (reference: Frontier is always minimal,
+    # src/frontier.rs:23).
+    if len(frontier) > 1:
+        frontier = list(ol.cg.graph.find_dominators(frontier))
+    # Positions are only meaningful against the document AT THE OP'S
+    # PARENTS: an out-of-range op accepted here is persisted and poisons
+    # every future merge on every peer, so length-check before mutating.
+    if cache is not None and cache.get("frontier") == tuple(frontier):
+        blen = cache["blen"]
+    else:
+        blen = len(ol.checkout(frontier))
     if op.get("kind") == "ins":
-        ol.add_insert_at(agent, frontier, _ix(op["pos"]),
-                         str(op["content"]))
+        pos = _ix(op["pos"])
+        content = op.get("content")
+        if not (isinstance(content, str) and content):
+            raise ValueError("bad ins content")
+        if not 0 <= pos <= blen:
+            raise ValueError(f"ins pos {pos} out of range 0..{blen}")
+        if agent is None:
+            agent = ol.get_or_create_agent_id(name)
+        lv = ol.add_insert_at(agent, frontier, pos, content)
+        blen += len(content)
     elif op.get("kind") == "del":
         start = _ix(op["pos"])
         n = _ix(op["len"])
+        if n < 1 or not 0 <= start or start + n > blen:
+            raise ValueError(
+                f"del range {start}+{n} out of range 0..{blen}")
         # content=None: deleted text is recoverable from history; a full
         # checkout per unit delete under store.lock would be O(history)
         # per character
-        ol.add_delete_at(agent, frontier, start, start + n, None)
+        if agent is None:
+            agent = ol.get_or_create_agent_id(name)
+        lv = ol.add_delete_at(agent, frontier, start, start + n, None)
+        blen -= n
     else:
         raise ValueError("bad crdt op kind")
+    if cache is not None:
+        cache["frontier"] = (lv,)
+        cache["blen"] = blen
 
 
 def _crdt_ops_since(ol: OpLog, have: dict) -> list:
@@ -264,7 +306,10 @@ def doc_history_strip(ol: OpLog, n: int, tip: Optional[list] = None):
     if n_entries and os.environ.get("DT_SERVER_DEVICE"):
         from ..native import native_available
         from ..tpu.plan_kernels import texts_at_versions
-        take = min(max(n - 1, 1), n_entries)
+        if n == 1:   # strip budget fits only the merged-tip snapshot
+            return [{"lv": int(max(t for t in tip)),
+                     "text": ol.checkout(tip).snapshot()}]
+        take = min(n - 1, n_entries)
         idxs = [round(i * (n_entries - 1) / max(take - 1, 1))
                 for i in range(take)]
         idxs = sorted(set(idxs))
@@ -506,8 +551,16 @@ class SyncHandler(BaseHTTPRequestHandler):
             applied = 0
             try:
                 with self.store.lock:
+                    cache = {}   # (frontier, blen) carried across the batch
                     for op in req.get("push") or []:
-                        _crdt_apply_op(ol, op)
+                        try:
+                            _crdt_apply_op(ol, op, cache)
+                        except AssertionError as e:
+                            # engine invariant tripped mid-apply (e.g. a doc
+                            # poisoned before op validation existed): a
+                            # client error, not a handler-thread crash loop
+                            raise ValueError(
+                                f"engine invariant: {e}") from e
                         applied += 1
                     out_ops = _crdt_ops_since(ol, req.get("have") or {})
                     ver = ol.cg.local_to_remote_frontier(ol.version)

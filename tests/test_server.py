@@ -495,3 +495,95 @@ def test_crdt_peer_offline_convergence_order_free():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_crdt_ops_endpoint_rejects_out_of_range(tmp_path):
+    """ADVICE r3 (high): /doc/{id}/ops must validate pos/len against the
+    document AT THE OP'S PARENTS before mutating — an accepted
+    out-of-range op is persisted and poisons every future merge."""
+    import json
+    import urllib.error
+    import urllib.request
+    srv, base = _boot_server()
+    try:
+        p = _CrdtPeer(base, "vdoc", "anna")
+        p.edit_ins(0, "hello")
+        p.sync()
+        store = srv.RequestHandlerClass.store
+        ol = store.get("vdoc")
+        assert ol.checkout_tip().snapshot() == "hello"
+        frontier = [["anna", 4]]
+
+        def push(op):
+            body = json.dumps({"have": {}, "push": [op]}).encode("utf8")
+            req = urllib.request.Request(base + "/doc/vdoc/ops", data=body)
+            return urllib.request.urlopen(req)
+
+        bad = [
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "ins", "pos": 999, "content": "X"},      # ins > len
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "ins", "pos": -1, "content": "X"},       # negative
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "ins", "pos": 0, "content": ""},         # empty ins
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "del", "pos": 3, "len": 99},             # del > len
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "del", "pos": 0, "len": 0},              # empty del
+            {"agent": "evil", "seq": 0, "parents": frontier,
+             "kind": "del", "pos": -2, "len": 1},             # negative
+        ]
+        for op in bad:
+            try:
+                push(op)
+                raise AssertionError(f"accepted bad op {op}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, op
+        # nothing was persisted; the doc still merges cleanly
+        assert ol.checkout_tip().snapshot() == "hello"
+        # boundary ops ARE valid: ins at len, del of last char
+        push({"agent": "evil", "seq": 0, "parents": frontier,
+              "kind": "ins", "pos": 5, "content": "!"})
+        push({"agent": "evil", "seq": 1, "parents": [["evil", 0]],
+              "kind": "del", "pos": 5, "len": 1})
+        assert ol.checkout_tip().snapshot() == "hello"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_crdt_ops_minimal_frontier_stored(tmp_path):
+    """ADVICE r3 (low): clients track frontiers as per-agent max-seq maps,
+    so pushed parents may include dominated heads; the server must store
+    the MINIMAL frontier (reference invariant: frontiers are minimal)."""
+    import json
+    import urllib.request
+    srv, base = _boot_server()
+    try:
+        a = _CrdtPeer(base, "mdoc", "aa")
+        a.edit_ins(0, "xy")
+        a.sync()
+        b = _CrdtPeer(base, "mdoc", "bb")
+        b.sync()
+        b.edit_ins(2, "z")   # bb's op builds on aa's tip
+        b.sync()
+        # now push an op whose parents list BOTH aa's tip (dominated by
+        # bb's op) and bb's op — the max-seq-map shape from the advice
+        body = json.dumps({"have": {}, "push": [
+            {"agent": "cc", "seq": 0,
+             "parents": [["aa", 1], ["bb", 0]],
+             "kind": "ins", "pos": 3, "content": "!"}]}).encode("utf8")
+        urllib.request.urlopen(
+            urllib.request.Request(base + "/doc/mdoc/ops", data=body))
+        store = srv.RequestHandlerClass.store
+        ol = store.get("mdoc")
+        lv = ol.cg.remote_to_local_frontier([("cc", 0)])[0]
+        parents = ol.cg.graph.parents_at(lv)
+        # minimal: only bb's op (aa's tip is its ancestor)
+        assert list(parents) == \
+            list(ol.cg.remote_to_local_frontier([("bb", 0)])), \
+            f"non-minimal parents stored: {parents}"
+        assert ol.checkout_tip().snapshot() == "xyz!"
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -227,3 +227,97 @@ def test_paged_crash_fuzz(tmp_path):
         assert got3 == expect_all[:len(got3)], \
             f"trial {trial}: second crash broke the prefix invariant"
         s4.close()
+
+
+def _newest_image_slot(path, stream, idx):
+    """Slot holding the newest on-disk image of (stream, idx)."""
+    from diamond_types_tpu.storage.pages import _HDR, PAGE_SIZE
+    from diamond_types_tpu.encoding.crc32c import crc32c
+    data = open(path, "rb").read()
+    hit, hit_key = None, None
+    for slot in range(len(data) // PAGE_SIZE):
+        raw = data[slot * PAGE_SIZE:(slot + 1) * PAGE_SIZE]
+        crc, s, _b, used, i, gen, seq = _HDR.unpack(raw[:_HDR.size])
+        if crc32c(raw[4:]) != crc:
+            continue
+        if s == stream and i == idx and (hit_key is None
+                                         or (gen, seq) > hit_key):
+            hit, hit_key = slot, (gen, seq)
+    return hit
+
+
+def test_paged_rollback_suffix_not_respliced(tmp_path):
+    """ADVICE r3 (high): a crash tearing a record that SPANS pages leaves
+    valid same-gen spill pages beyond the rolled-back tail; after a clean
+    intervening append+close, the next recovery's chain walk used to
+    splice those stale bytes back in as phantom records."""
+    import struct
+    from diamond_types_tpu.storage.pages import PAGE_SIZE, PagedStore
+    p = str(tmp_path / "x.pages")
+    s = PagedStore(p)
+    rec1 = b"A" * 100
+    # rec2's body is a stream of zero-length record frames: if its sealed
+    # spill pages are ever spliced back, they parse as hundreds of empty
+    # phantom records (the worst-case misparse from the advice repro)
+    rec2 = struct.pack("<I", 0) * 2300   # 9200 bytes -> spans 3 pages
+    s.append(1, rec1)
+    s.append(1, rec2)
+    s.close()
+    # crash = the final tail write (idx 2) torn: zero that page image
+    slot = _newest_image_slot(p, 1, 2)
+    assert slot is not None
+    data = bytearray(open(p, "rb").read())
+    data[slot * PAGE_SIZE:(slot + 1) * PAGE_SIZE] = b"\0" * PAGE_SIZE
+    open(p, "wb").write(bytes(data))
+
+    s2 = PagedStore(p)   # rolls rec2 back (its tail bytes are gone)
+    assert list(s2.records(1)) == [rec1]
+    s2.append(1, b"fresh")
+    s2.close()           # CLEAN close
+
+    s3 = PagedStore(p)
+    assert list(s3.records(1)) == [rec1, b"fresh"], \
+        "stale spill pages of the rolled-back record were re-spliced"
+    s3.append(1, b"more")
+    s3.close()
+    s4 = PagedStore(p)
+    assert list(s4.records(1)) == [rec1, b"fresh", b"more"]
+    s4.close()
+
+
+def test_paged_first_post_recovery_write_torn(tmp_path):
+    """The first tail write after recovery must target the slot NOT
+    holding the newest tail image: if that write tears, previously
+    committed records must still be readable (blit alternation parity
+    must be re-derived at recovery, not inherited from seal_seq)."""
+    from diamond_types_tpu.storage.pages import PAGE_SIZE, PagedStore
+
+    # Drive both parities: vary the number of small appends pre-crash.
+    for n_pre in (1, 2, 3, 4, 5):
+        p = str(tmp_path / f"p{n_pre}.pages")
+        s = PagedStore(p)
+        recs = [bytes([65 + i]) * (10 + i) for i in range(n_pre)]
+        for r in recs:
+            s.append(1, r)
+        s.close()
+        # crash 1: truncate mid-final-page write (tear whatever was last)
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:len(data) - PAGE_SIZE // 2])
+        s2 = PagedStore(p)
+        got = list(s2.records(1))
+        assert got == recs[:len(got)]
+        committed = list(got)
+        s2.append(1, b"after")
+        s2.close()
+        # crash 2: tear ONLY the newest tail image (the post-recovery
+        # write); everything committed before it must survive
+        slot = _newest_image_slot(p, 1, 0)
+        data = bytearray(open(p, "rb").read())
+        data[slot * PAGE_SIZE:(slot + 1) * PAGE_SIZE] = b"\0" * PAGE_SIZE
+        open(p, "wb").write(bytes(data))
+        s3 = PagedStore(p)
+        got3 = list(s3.records(1))
+        assert got3[:len(committed)] == committed, (
+            f"n_pre={n_pre}: records committed before the torn "
+            f"post-recovery write were lost: {got3} vs {committed}")
+        s3.close()
