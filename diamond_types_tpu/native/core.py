@@ -136,6 +136,19 @@ def _load():
         np.ctypeslib.ndpointer(np.uint64, flags="C"), ct.c_int64]
     lib.dt_get_counters.restype = ct.c_int64
     lib.dt_reset_counters.argtypes = []
+    _i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+    _i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+    _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+    lib.dt_compose_plan.argtypes = [ct.c_void_p, ct.c_int64, _i64p, _i64p]
+    lib.dt_compose_plan.restype = ct.c_int64
+    lib.dt_compose_counts.argtypes = [ct.c_void_p, _i64p]
+    lib.dt_compose_fetch.argtypes = [
+        ct.c_void_p, _i64p, _i64p, _i32p, _u8p, _u8p, _i64p, _i32p,
+        _i64p, _i64p, _i32p, _i64p, _i32p, _i32p,
+        _i64p, _i64p, _i64p, _i64p]
+    lib.dt_compose_linear.argtypes = [ct.c_void_p, ct.c_int64, _i64p, _i64p]
+    lib.dt_compose_linear.restype = ct.c_int64
+    lib.dt_fetch_linear.argtypes = [ct.c_void_p, _i64p, _i64p]
     _lib = lib
     return lib
 
@@ -235,6 +248,92 @@ class NativeContext:
         frontier = [int(x) for x in fbuf[:k]]
         return lv, ln, kind, fwd, pos, frontier
 
+
+    def compose_plan(self, spans):
+        """Native zone-engine composer (listmerge/compose.py's hot path in
+        C++): compose each entry span into entry-start coordinates.
+        Returns a list of per-entry column dicts, or None on unsupported
+        input (reverse insert runs) — the caller falls back to Python."""
+        self.sync()
+        lib = self._lib
+        n = len(spans)
+        s0 = np.ascontiguousarray([s for s, _ in spans], dtype=np.int64)
+        s1 = np.ascontiguousarray([e for _, e in spans], dtype=np.int64)
+        if n == 0:
+            return []
+        if lib.dt_compose_plan(self._ptr, n, s0, s1) != 0:
+            return None
+        counts = np.empty(n * 5, dtype=np.int64)
+        lib.dt_compose_counts(self._ptr, counts)
+        counts = counts.reshape(n, 5)
+        tq, tc, tb, tdb, tdo = (int(x) for x in counts.sum(axis=0))
+        q = np.empty(tq, dtype=np.int64)
+        ch_lv = np.empty(tc, dtype=np.int64)
+        ch_block = np.empty(tc, dtype=np.int32)
+        ch_head = np.empty(tc, dtype=np.uint8)
+        ch_kind = np.empty(tc, dtype=np.uint8)
+        ch_anchor = np.empty(tc, dtype=np.int64)
+        ch_q = np.empty(tc, dtype=np.int32)
+        ch_headlv = np.empty(tc, dtype=np.int64)
+        ch_orrown = np.empty(tc, dtype=np.int64)
+        blk_root_q = np.empty(tb, dtype=np.int32)
+        blk_root_lv = np.empty(tb, dtype=np.int64)
+        blk_start = np.empty(tb, dtype=np.int32)
+        blk_len = np.empty(tb, dtype=np.int32)
+        db0 = np.empty(tdb, dtype=np.int64)
+        db1 = np.empty(tdb, dtype=np.int64)
+        do0 = np.empty(tdo, dtype=np.int64)
+        do1 = np.empty(tdo, dtype=np.int64)
+        lib.dt_compose_fetch(self._ptr, q, ch_lv, ch_block, ch_head,
+                             ch_kind, ch_anchor, ch_q, ch_headlv, ch_orrown,
+                             blk_root_q, blk_root_lv, blk_start, blk_len,
+                             db0, db1, do0, do1)
+        out = []
+        oq = oc = ob = odb = odo = 0
+        for k in range(n):
+            nq, nc, nb, ndb, ndo = (int(x) for x in counts[k])
+            out.append({
+                "q_cursor": q[oq:oq + nq].tolist(),
+                "ch_lv": ch_lv[oc:oc + nc],
+                "ch_block": ch_block[oc:oc + nc],
+                "ch_head": ch_head[oc:oc + nc].astype(np.int8),
+                "ch_kind": ch_kind[oc:oc + nc].astype(np.int8),
+                "ch_anchor": ch_anchor[oc:oc + nc],
+                "ch_q": ch_q[oc:oc + nc],
+                "ch_headlv": ch_headlv[oc:oc + nc],
+                "ch_orrown": ch_orrown[oc:oc + nc],
+                "blk_root_q": blk_root_q[ob:ob + nb],
+                "blk_root_lv": blk_root_lv[ob:ob + nb],
+                "blk_start": blk_start[ob:ob + nb],
+                "blk_len": blk_len[ob:ob + nb],
+                "del_base": list(zip(db0[odb:odb + ndb].tolist(),
+                                     db1[odb:odb + ndb].tolist())),
+                "del_own": list(zip(do0[odo:odo + ndo].tolist(),
+                                    do1[odo:odo + ndo].tolist())),
+            })
+            oq += nq
+            oc += nc
+            ob += nb
+            odb += ndb
+            odo += ndo
+        return out
+
+    def compose_linear(self, spans):
+        """Alive own pieces (lv, len arrays) of a linear-history
+        composition over an empty base (assemble_prefix's hot loop), or
+        None on unsupported input."""
+        self.sync()
+        lib = self._lib
+        s0 = np.ascontiguousarray([s for s, _ in spans], dtype=np.int64)
+        s1 = np.ascontiguousarray([e for _, e in spans], dtype=np.int64)
+        n = lib.dt_compose_linear(self._ptr, len(spans), s0, s1)
+        if n < 0:
+            return None
+        lv = np.empty(n, dtype=np.int64)
+        ln = np.empty(n, dtype=np.int64)
+        if n:
+            lib.dt_fetch_linear(self._ptr, lv, ln)
+        return lv, ln
 
     def release_tracker(self) -> None:
         """Free the tracker tables retained for dump_tracker/zone_common."""

@@ -83,7 +83,8 @@ class PagedStore:
     # ---- low-level page IO ----------------------------------------------
 
     def _write_page(self, slot: int, stream: int, is_blit: int, used: int,
-                    idx: int, gen: int, seq: int, payload: bytes) -> None:
+                    idx: int, gen: int, seq: int, payload: bytes,
+                    sync: bool = True) -> None:
         assert len(payload) <= PAYLOAD and slot is not None
         body = _HDR.pack(0, stream, is_blit, used, idx, gen, seq) + \
             payload.ljust(PAYLOAD, b"\0")
@@ -91,7 +92,8 @@ class PagedStore:
         self._f.seek(slot * PAGE_SIZE)
         self._f.write(page)
         self._f.flush()
-        os.fsync(self._f.fileno())
+        if sync:
+            os.fsync(self._f.fileno())
         self.bytes_written += PAGE_SIZE
         self.page_writes += 1
 
@@ -202,11 +204,17 @@ class PagedStore:
             # tail on disk. Without killing them, a later recovery's chain
             # walk splices their bytes back into the record stream (after a
             # clean intervening close), yielding phantom/garbage records.
+            # Deferred-fsync batch: losing these writes to a crash is safe
+            # (the next recovery deterministically redoes the identical
+            # rollback), so one trailing fsync covers the whole suffix
+            # instead of one per page.
+            killed = False
             for key in [k for k in main_slot
                         if k[0] == stream and k[1] == gen and k[2] > n_full]:
                 self._write_page(main_slot[key][1], _DEAD, 0, 0, 0, 0, 0,
-                                 b"")
+                                 b"", sync=False)
                 del main_slot[key]
+                killed = True
             bl = blit.get(stream)
             if bl is not None and bl[3] == gen and bl[2] > n_full:
                 # Stale high-idx tail image on the blit slot: overwrite it
@@ -215,7 +223,11 @@ class PagedStore:
                 # slot as this stream's blit, or it would be leaked and a
                 # fresh slot allocated per rollback+reopen). seq 0 loses to
                 # any real tail image at this idx.
-                self._write_page(bl[1], stream, 1, 0, n_full, gen, 0, b"")
+                self._write_page(bl[1], stream, 1, 0, n_full, gen, 0, b"",
+                                 sync=False)
+                killed = True
+            if killed:
+                os.fsync(self._f.fileno())
             # New tail writes must outrank ANY stale image of this chain
             # (rollback can re-point the tail at a page whose on-disk image
             # carries a higher seq; ditto re-sealed pages above). Parity

@@ -202,7 +202,7 @@ def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     # name registered (rejected-only traffic would otherwise grow the
     # agent table without bound, and the junk names get persisted by the
     # next legitimate flush). The agent is created only at mutation time.
-    agent = aa.agent_names.index(name) if name in aa.agent_names else None
+    agent = aa.try_get_agent(name)
     nxt = 0 if agent is None else _crdt_next_seq(aa, agent)
     if seq < nxt:
         return   # already known (client re-push after a dropped response)

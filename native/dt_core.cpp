@@ -1899,6 +1899,298 @@ struct TextBuf {
   }
 };
 
+// ------------------------------------------------------------- composer
+//
+// Piece-table composer for the zone engine's host prep: composes one
+// conflict-zone entry's sequential op stream into entry-start coordinates
+// (a faithful port of diamond_types_tpu/listmerge/compose.py — see that
+// module's docstring for the semantics; reference equivalent of the work
+// it replaces: the per-op tracker origin scan, src/listmerge/merge.rs:
+// 395-423). Treap over piece nodes in an index arena; the tree SHAPE may
+// differ from the Python treap (priorities are independent randomness)
+// but the in-order piece sequence — the only thing finish() reads — is
+// identical.
+
+using u64 = unsigned long long;
+
+static const i64 COMP_BASE_INF = (i64)1 << 40;
+static const u8 COMP_K_OWN = 1, COMP_K_LEFTJOIN = 2, COMP_K_ROOT = 3;
+
+struct CompPiece {
+  i64 base;      // >= 0: snapshot chars [base, base+length); -1: own chars
+  i64 lv;        // own chars [lv, lv+length)
+  i64 length;
+  int headi;     // own: index into Composer::heads (governing run head)
+  u64 prio;
+  int l, r, up;
+  i64 sub_alive;
+  bool alive;
+};
+
+struct CompHead {
+  u8 kind;        // COMP_K_*
+  i64 anchor_lv;  // own-char anchor (K_OWN parent / K_LEFTJOIN parent)
+  int q;          // query idx (K_LEFTJOIN ol / K_ROOT), else -1
+  int block;      // block id the run belongs to
+  i64 orr_own;    // own-char origin-right lv, or -1 = the block's B
+  i64 head_lv;    // the run head char's own lv
+};
+
+// One entry's composition result (mirror of compose.ComposedEntry).
+struct ComposedOut {
+  std::vector<i64> q_cursor;
+  std::vector<i64> ch_lv, ch_anchor, ch_headlv, ch_orrown;
+  std::vector<int32_t> ch_block, ch_q;
+  std::vector<u8> ch_head, ch_kind;
+  std::vector<int32_t> blk_root_q, blk_start, blk_len;
+  std::vector<i64> blk_root_lv;
+  std::vector<i64> db0, db1, do0, do1;  // del_base / del_own pairs
+};
+
+struct Composer {
+  std::vector<CompPiece> A;
+  std::vector<CompHead> heads;
+  int root = -1;
+  u64 prio_state = 0x9E3779B97F4A7C15ull;
+  std::vector<i64> q_cursor;
+  int n_blocks = 0;
+  std::vector<i64> blk_root_lv_all;   // block id -> root head char lv
+  std::vector<int> blk_root_headi;    // block id -> root head meta idx
+  std::vector<std::pair<i64, i64>> del_base, del_own;
+  bool failed = false;
+
+  Composer(bool with_base) {
+    if (with_base) {
+      A.push_back({0, -1, COMP_BASE_INF, -1, next_prio(), -1, -1, -1,
+                   COMP_BASE_INF, true});
+      root = 0;
+    }
+  }
+
+  u64 next_prio() {   // splitmix64
+    prio_state += 0x9E3779B97F4A7C15ull;
+    u64 z = prio_state;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+
+  inline void upd(int n) {
+    CompPiece& p = A[n];
+    i64 s = p.alive ? p.length : 0;
+    if (p.l >= 0) s += A[p.l].sub_alive;
+    if (p.r >= 0) s += A[p.r].sub_alive;
+    p.sub_alive = s;
+  }
+
+  void fix_up(int n) { while (n >= 0) { upd(n); n = A[n].up; } }
+
+  void rot_up(int x) {
+    int p = A[x].up, g = A[p].up;
+    if (A[p].l == x) {
+      A[p].l = A[x].r;
+      if (A[p].l >= 0) A[A[p].l].up = p;
+      A[x].r = p;
+    } else {
+      A[p].r = A[x].l;
+      if (A[p].r >= 0) A[A[p].r].up = p;
+      A[x].l = p;
+    }
+    A[p].up = x;
+    A[x].up = g;
+    if (g >= 0) { if (A[g].l == p) A[g].l = x; else A[g].r = x; }
+    else root = x;
+    upd(p);
+    upd(x);
+  }
+
+  void bubble(int x) {
+    while (A[x].up >= 0 && A[A[x].up].prio < A[x].prio) rot_up(x);
+    if (A[x].up < 0) root = x; else fix_up(A[x].up);
+  }
+
+  void insert_after(int a, int x) {
+    if (a < 0) {
+      int n = root;
+      if (n < 0) { root = x; return; }
+      while (A[n].l >= 0) n = A[n].l;
+      A[n].l = x;
+      A[x].up = n;
+    } else if (A[a].r < 0) {
+      A[a].r = x;
+      A[x].up = a;
+    } else {
+      int n = A[a].r;
+      while (A[n].l >= 0) n = A[n].l;
+      A[n].l = x;
+      A[x].up = n;
+    }
+    fix_up(A[x].up);
+    bubble(x);
+  }
+
+  int succ(int n) const {
+    if (A[n].r >= 0) {
+      n = A[n].r;
+      while (A[n].l >= 0) n = A[n].l;
+      return n;
+    }
+    while (A[n].up >= 0 && A[A[n].up].r == n) n = A[n].up;
+    return A[n].up;
+  }
+
+  int leftmost() const {
+    int n = root;
+    if (n < 0) return -1;
+    while (A[n].l >= 0) n = A[n].l;
+    return n;
+  }
+
+  // (piece, offset) of visible char pos; piece < 0 on out-of-range
+  std::pair<int, i64> find_visible(i64 pos) const {
+    int n = root;
+    while (n >= 0) {
+      const CompPiece& p = A[n];
+      i64 la = p.l >= 0 ? A[p.l].sub_alive : 0;
+      if (pos < la) n = p.l;
+      else if (p.alive && pos < la + p.length) return {n, pos - la};
+      else { pos -= la + (p.alive ? p.length : 0); n = p.r; }
+    }
+    return {-1, 0};
+  }
+
+  int split(int n, i64 off) {
+    int right;
+    CompPiece& p0 = A[n];
+    if (p0.base >= 0)
+      A.push_back({p0.base + off, -1, p0.length - off, -1, next_prio(),
+                   -1, -1, -1, 0, p0.alive});
+    else
+      A.push_back({-1, p0.lv + off, p0.length - off, p0.headi, next_prio(),
+                   -1, -1, -1, 0, p0.alive});
+    right = (int)A.size() - 1;
+    A[right].sub_alive = A[right].alive ? A[right].length : 0;
+    A[n].length = off;
+    fix_up(n);
+    insert_after(n, right);
+    return right;
+  }
+
+  int emit_query(int prev) {
+    // query gap must follow a snapshot piece (or doc start)
+    if (prev >= 0 && A[prev].base < 0) { failed = true; return -1; }
+    q_cursor.push_back(prev < 0 ? 0 : A[prev].base + A[prev].length);
+    return (int)q_cursor.size() - 1;
+  }
+
+  void insert(i64 pos, i64 lv, i64 length) {
+    int prev;
+    if (pos == 0) prev = -1;
+    else {
+      auto [node, off] = find_visible(pos - 1);
+      if (node < 0) { failed = true; return; }
+      if (off + 1 < A[node].length) split(node, off + 1);
+      prev = node;
+    }
+    int nxt = prev >= 0 ? succ(prev) : leftmost();
+    i64 orr_own = (nxt >= 0 && A[nxt].base < 0) ? A[nxt].lv : -1;
+    int headi = (int)heads.size();
+    if (prev >= 0 && A[prev].base < 0) {
+      // ol is an own char: right child of it (K_OWN)
+      i64 anchor = A[prev].lv + A[prev].length - 1;
+      heads.push_back({COMP_K_OWN, anchor, -1, heads[A[prev].headi].block,
+                       orr_own, lv});
+    } else if (nxt >= 0 && A[nxt].base < 0) {
+      // ol snapshot/doc-start, next piece own: left-join that block
+      int q = emit_query(prev);
+      heads.push_back({COMP_K_LEFTJOIN, A[nxt].lv, q,
+                       heads[A[nxt].headi].block, orr_own, lv});
+    } else {
+      int q = emit_query(prev);
+      int blk = n_blocks++;
+      blk_root_lv_all.push_back(lv);
+      blk_root_headi.push_back(headi);
+      heads.push_back({COMP_K_ROOT, -1, q, blk, -1, lv});
+    }
+    A.push_back({-1, lv, length, headi, next_prio(), -1, -1, -1,
+                 length, true});
+    insert_after(prev, (int)A.size() - 1);
+  }
+
+  void del(i64 pos, i64 length) {
+    auto [node, off] = find_visible(pos);
+    if (node < 0) { failed = true; return; }
+    if (off > 0) node = split(node, off);
+    i64 remaining = length;
+    while (remaining > 0) {
+      if (node < 0) { failed = true; return; }  // delete past end
+      if (!A[node].alive) { node = succ(node); continue; }
+      i64 take = std::min(remaining, A[node].length);
+      if (take < A[node].length) split(node, take);
+      if (A[node].base >= 0)
+        del_base.emplace_back(A[node].base, A[node].base + take);
+      else
+        del_own.emplace_back(A[node].lv, A[node].lv + take);
+      A[node].alive = false;
+      fix_up(node);
+      remaining -= take;
+      node = succ(node);
+    }
+  }
+
+  void finish(ComposedOut& out) {
+    out.q_cursor = std::move(q_cursor);
+    for (auto& d : del_base) { out.db0.push_back(d.first);
+                               out.db1.push_back(d.second); }
+    for (auto& d : del_own)  { out.do0.push_back(d.first);
+                               out.do1.push_back(d.second); }
+    // in-order walk collecting own pieces grouped by block id;
+    // intra-block order IS table order
+    struct PBRow { i64 lv, len; int headi; };
+    std::vector<std::vector<PBRow>> pb(n_blocks);
+    {
+      std::vector<int> st;
+      int cur = root;
+      while (!st.empty() || cur >= 0) {
+        while (cur >= 0) { st.push_back(cur); cur = A[cur].l; }
+        cur = st.back();
+        st.pop_back();
+        const CompPiece& p = A[cur];
+        if (p.base < 0)
+          pb[heads[p.headi].block].push_back({p.lv, p.length, p.headi});
+        cur = p.r;
+      }
+    }
+    for (int blk = 0; blk < n_blocks; blk++) {
+      if (pb[blk].empty()) continue;   // dense output block reindex
+      int bi = (int)out.blk_start.size();
+      i64 total = out.ch_lv.size();
+      i64 blen = 0;
+      for (auto& t : pb[blk]) blen += t.len;
+      out.blk_start.push_back((int32_t)total);
+      out.blk_len.push_back((int32_t)blen);
+      out.blk_root_lv.push_back(blk_root_lv_all[blk]);
+      out.blk_root_q.push_back(heads[blk_root_headi[blk]].q);
+      for (auto& t : pb[blk]) {
+        i64 lv = t.lv, ln = t.len;
+        const CompHead& h = heads[t.headi];
+        for (i64 k = 0; k < ln; k++) {
+          i64 clv = lv + k;
+          bool is_head = clv == h.head_lv;
+          out.ch_lv.push_back(clv);
+          out.ch_block.push_back(bi);
+          out.ch_headlv.push_back(h.head_lv);
+          out.ch_orrown.push_back(h.orr_own);
+          out.ch_head.push_back(is_head ? 1 : 0);
+          out.ch_kind.push_back(is_head ? h.kind : 0);
+          out.ch_anchor.push_back(is_head ? h.anchor_lv : -1);
+          out.ch_q.push_back(is_head ? h.q : -1);
+        }
+      }
+    }
+  }
+};
+
 struct Ctx {
   Graph g;
   Agents aa;
@@ -1915,7 +2207,38 @@ struct Ctx {
   std::vector<i64> zone_common;
   // collisions of the LAST transform (survives release_tracker)
   i64 last_collisions = 0;
+  // last dt_compose_plan / dt_compose_linear results
+  std::vector<ComposedOut> composed;
+  std::vector<std::pair<i64, i64>> linear_pieces;
 };
+
+// Feed one span's op runs through a composer (mirror of
+// compose.compose_entry's iter_range loop). False on unsupported input
+// (reverse insert runs — matches reference merge.rs:384 unimplemented!).
+static bool compose_span_ops(Ctx* c, Composer& comp, Span span) {
+  Ops& ops = c->ops;
+  if (span_empty(span)) return true;
+  size_t i = ops.find_idx(span.start);
+  i64 pos = span.start;
+  while (pos < span.end) {
+    const OpRun& run = ops.runs[i];
+    i64 run_end = run.lv + (run.end - run.start);
+    i64 o0 = pos - run.lv;
+    i64 o1 = std::min(span.end, run_end) - run.lv;
+    OpRun piece = Ops::slice(run, o0, o1);
+    i64 plen = piece.end - piece.start;
+    if (piece.kind == INS) {
+      if (!piece.fwd) return false;
+      comp.insert(piece.start, piece.lv, plen);
+    } else {
+      comp.del(piece.start, plen);
+    }
+    if (comp.failed) return false;
+    pos = run.lv + o1;
+    i++;
+  }
+  return true;
+}
 
 static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
                            bool emit) {
@@ -2256,5 +2579,110 @@ void dt_reset_counters() { g_events = EventCounters{}; }
 // Colliding concurrent inserts during the last dt_transform on this ctx
 // (reference: has_conflicts_when_merging, src/list/merge.rs:51).
 i64 dt_last_collisions(void* p) { return ((Ctx*)p)->last_collisions; }
+
+// ---- zone-engine composer (host prep; see Composer above) ----
+//
+// Protocol: dt_compose_plan composes every entry span and caches the
+// results in the ctx; dt_compose_counts reports per-entry sizes (5 i64
+// each: nq, nch, nblk, ndel_base, ndel_own); dt_compose_fetch fills the
+// caller's flat arrays (entry-concatenated, entry-local indices) and
+// frees the cache. Returns -1 on unsupported input (reverse insert
+// runs / out-of-range positions) — caller falls back to Python.
+i64 dt_compose_plan(void* p, i64 n, const i64* s0, const i64* s1) {
+  Ctx* c = (Ctx*)p;
+  c->composed.clear();
+  c->composed.resize((size_t)n);
+  for (i64 k = 0; k < n; k++) {
+    Composer comp(true);
+    if (!compose_span_ops(c, comp, {s0[k], s1[k]})) {
+      c->composed.clear();
+      return -1;
+    }
+    comp.finish(c->composed[k]);
+  }
+  return 0;
+}
+
+void dt_compose_counts(void* p, i64* out) {
+  Ctx* c = (Ctx*)p;
+  for (size_t k = 0; k < c->composed.size(); k++) {
+    const ComposedOut& o = c->composed[k];
+    out[k * 5 + 0] = (i64)o.q_cursor.size();
+    out[k * 5 + 1] = (i64)o.ch_lv.size();
+    out[k * 5 + 2] = (i64)o.blk_start.size();
+    out[k * 5 + 3] = (i64)o.db0.size();
+    out[k * 5 + 4] = (i64)o.do0.size();
+  }
+}
+
+void dt_compose_fetch(void* p, i64* q, i64* ch_lv, int32_t* ch_block,
+                      u8* ch_head, u8* ch_kind, i64* ch_anchor,
+                      int32_t* ch_q, i64* ch_headlv, i64* ch_orrown,
+                      int32_t* blk_root_q, i64* blk_root_lv,
+                      int32_t* blk_start, int32_t* blk_len,
+                      i64* db0, i64* db1, i64* do0, i64* do1) {
+  Ctx* c = (Ctx*)p;
+  size_t iq = 0, ic = 0, ib = 0, idb = 0, ido = 0;
+  for (const ComposedOut& o : c->composed) {
+    std::copy(o.q_cursor.begin(), o.q_cursor.end(), q + iq);
+    iq += o.q_cursor.size();
+    std::copy(o.ch_lv.begin(), o.ch_lv.end(), ch_lv + ic);
+    std::copy(o.ch_block.begin(), o.ch_block.end(), ch_block + ic);
+    std::copy(o.ch_head.begin(), o.ch_head.end(), ch_head + ic);
+    std::copy(o.ch_kind.begin(), o.ch_kind.end(), ch_kind + ic);
+    std::copy(o.ch_anchor.begin(), o.ch_anchor.end(), ch_anchor + ic);
+    std::copy(o.ch_q.begin(), o.ch_q.end(), ch_q + ic);
+    std::copy(o.ch_headlv.begin(), o.ch_headlv.end(), ch_headlv + ic);
+    std::copy(o.ch_orrown.begin(), o.ch_orrown.end(), ch_orrown + ic);
+    ic += o.ch_lv.size();
+    std::copy(o.blk_root_q.begin(), o.blk_root_q.end(), blk_root_q + ib);
+    std::copy(o.blk_root_lv.begin(), o.blk_root_lv.end(), blk_root_lv + ib);
+    std::copy(o.blk_start.begin(), o.blk_start.end(), blk_start + ib);
+    std::copy(o.blk_len.begin(), o.blk_len.end(), blk_len + ib);
+    ib += o.blk_start.size();
+    std::copy(o.db0.begin(), o.db0.end(), db0 + idb);
+    std::copy(o.db1.begin(), o.db1.end(), db1 + idb);
+    idb += o.db0.size();
+    std::copy(o.do0.begin(), o.do0.end(), do0 + ido);
+    std::copy(o.do1.begin(), o.do1.end(), do1 + ido);
+    ido += o.do0.size();
+  }
+  c->composed.clear();
+  c->composed.shrink_to_fit();
+}
+
+// Linear fast-forward prefix composition (assemble_prefix's hot loop):
+// compose the (sorted, causally linear) spans over an EMPTY base and
+// return the alive own pieces in document order — the caller joins their
+// arena content. Returns piece count, or -1 on unsupported input.
+i64 dt_compose_linear(void* p, i64 nspans, const i64* s0, const i64* s1) {
+  Ctx* c = (Ctx*)p;
+  Composer comp(false);
+  for (i64 k = 0; k < nspans; k++)
+    if (!compose_span_ops(c, comp, {s0[k], s1[k]})) return -1;
+  c->linear_pieces.clear();
+  std::vector<int> st;
+  int cur = comp.root;
+  while (!st.empty() || cur >= 0) {
+    while (cur >= 0) { st.push_back(cur); cur = comp.A[cur].l; }
+    cur = st.back();
+    st.pop_back();
+    const CompPiece& pc = comp.A[cur];
+    if (pc.base < 0 && pc.alive)
+      c->linear_pieces.emplace_back(pc.lv, pc.length);
+    cur = pc.r;
+  }
+  return (i64)c->linear_pieces.size();
+}
+
+void dt_fetch_linear(void* p, i64* lv, i64* len) {
+  Ctx* c = (Ctx*)p;
+  for (size_t i = 0; i < c->linear_pieces.size(); i++) {
+    lv[i] = c->linear_pieces[i].first;
+    len[i] = c->linear_pieces[i].second;
+  }
+  c->linear_pieces.clear();
+  c->linear_pieces.shrink_to_fit();
+}
 
 }  // extern "C"

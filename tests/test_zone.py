@@ -136,3 +136,52 @@ def test_zone_empty_and_linear():
     txt, fr = zone_checkout_np(ol)
     assert txt == "ello world"
     assert sorted(fr) == sorted(ol.version)
+
+
+@pytest.mark.parametrize("corpus", ["friendsforever.dt", "git-makefile.dt"])
+def test_native_composer_matches_python(corpus):
+    """The C++ composer (native/dt_core.cpp Composer) must produce
+    column-identical output to the pure-Python EntryComposer it
+    accelerates — the Python path stays live as the DT_TPU_NO_NATIVE /
+    unsupported-input fallback, so divergence would split the engines."""
+    import numpy as np
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.listmerge import compose as C
+    from diamond_types_tpu.listmerge.plan2 import compile_plan2
+    from diamond_types_tpu.native import native_available
+    if not native_available() or os.environ.get("DT_TPU_NO_NATIVE"):
+        pytest.skip("native library unavailable")
+    with open(os.path.join(BENCH_DATA, corpus), "rb") as f:
+        ol = load_oplog(f.read())
+    plan = compile_plan2(ol.cg.graph, [], list(ol.version))
+    spans = [en.span for en in plan.entries]
+    nat = C._native_composed(ol, spans)
+    assert nat is not None
+    py = [C.compose_entry(ol, s) for s in spans]
+    assert len(nat) == len(py)
+    for i, (a, b) in enumerate(zip(py, nat)):
+        assert list(a.q_cursor) == list(b.q_cursor), f"entry {i}"
+        assert [tuple(x) for x in a.del_base] == \
+            [tuple(x) for x in b.del_base], f"entry {i}"
+        assert [tuple(x) for x in a.del_own] == \
+            [tuple(x) for x in b.del_own], f"entry {i}"
+        for fld in ("ch_lv", "ch_block", "ch_head", "ch_kind", "ch_anchor",
+                    "ch_q", "ch_headlv", "ch_orrown", "blk_root_q",
+                    "blk_root_lv", "blk_start", "blk_len"):
+            assert np.array_equal(np.asarray(getattr(a, fld)),
+                                  np.asarray(getattr(b, fld))), \
+                f"entry {i} field {fld}"
+    # the linear-prefix composer too: native vs Python piece streams
+    if plan.ff_spans:
+        ctx = C._native_ctx_or_none(ol)
+        res = ctx.compose_linear(sorted(plan.ff_spans))
+        assert res is not None
+        os.environ["DT_TPU_NO_NATIVE"] = "1"
+        try:
+            expected = C.assemble_prefix(ol, plan.ff_spans)
+        finally:
+            del os.environ["DT_TPU_NO_NATIVE"]
+        lvs, lens = res
+        got = "".join(ol.ops.content_slice(int(lv), int(ln))
+                      for lv, ln in zip(lvs, lens))
+        assert got == expected
