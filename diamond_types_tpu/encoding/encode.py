@@ -141,6 +141,22 @@ class _ContentChunk:
 def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
                  from_version: Optional[Sequence[int]] = None) -> bytes:
     from_version = sorted(from_version) if from_version else []
+    if not from_version and not opts.store_deleted_content:
+        # Full-snapshot fast path: the C++ writer (native/dt_core.cpp
+        # encode_full_impl) covers the ENCODE_FULL shape; its txn walk
+        # order may differ from this writer's (bytes differ, decoded
+        # oplog identical — pinned by tests/test_encode.py). Patch
+        # encodes and deleted-content storage stay here.
+        import os
+        if not os.environ.get("DT_TPU_NO_NATIVE"):
+            from ..native import native_available
+            if native_available():
+                from ..native.core import get_native_ctx
+                blob = get_native_ctx(oplog).encode_full(
+                    oplog.doc_id, opts.user_data,
+                    opts.store_inserted_content, opts.compress_content)
+                if blob is not None:
+                    return blob
     graph = oplog.cg.graph
     aa = oplog.cg.agent_assignment
 
@@ -243,7 +259,9 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
         for piece in oplog.ops.iter_range(span):
             content = oplog.ops.get_run_content(piece)
             if piece.kind == INS and ins_content is not None:
-                assert content is not None, "insert content required"
+                # content may be unknown (oplog decoded from a blob
+                # written without inserted content): a known=false run,
+                # same as the native writer and the reference format
                 ins_content.push(content, len(piece))
             elif piece.kind == DEL and del_content is not None:
                 del_content.push(content, len(piece))

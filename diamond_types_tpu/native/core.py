@@ -149,6 +149,11 @@ def _load():
     lib.dt_compose_linear.argtypes = [ct.c_void_p, ct.c_int64, _i64p, _i64p]
     lib.dt_compose_linear.restype = ct.c_int64
     lib.dt_fetch_linear.argtypes = [ct.c_void_p, _i64p, _i64p]
+    lib.dt_encode_full.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_int64,
+                                   ct.c_char_p, ct.c_int64, ct.c_int64,
+                                   ct.c_int64]
+    lib.dt_encode_full.restype = ct.c_int64
+    lib.dt_encode_fetch.argtypes = [ct.c_void_p, _u8p]
     _lib = lib
     return lib
 
@@ -317,6 +322,23 @@ class NativeContext:
             odb += ndb
             odo += ndo
         return out
+
+    def encode_full(self, doc_id, user_data, store_ins: bool,
+                    compress: bool):
+        """Native v1 full-snapshot encode (from_version=[]); None on
+        failure (caller falls back to the Python writer)."""
+        self.sync()
+        lib = self._lib
+        did = doc_id.encode("utf8") if doc_id is not None else None
+        n = lib.dt_encode_full(
+            self._ptr, did, len(did) if did is not None else -1,
+            user_data, len(user_data) if user_data is not None else -1,
+            1 if store_ins else 0, 1 if compress else 0)
+        if n < 0:
+            return None
+        out = np.empty(n, dtype=np.uint8)
+        lib.dt_encode_fetch(self._ptr, out)
+        return out.tobytes()
 
     def compose_linear(self, spans):
         """Alive own pieces (lv, len arrays) of a linear-history

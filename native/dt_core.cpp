@@ -1912,6 +1912,7 @@ struct TextBuf {
 // identical.
 
 using u64 = unsigned long long;
+using u32 = unsigned int;
 
 static const i64 COMP_BASE_INF = (i64)1 << 40;
 static const u8 COMP_K_OWN = 1, COMP_K_LEFTJOIN = 2, COMP_K_ROOT = 3;
@@ -2210,6 +2211,15 @@ struct Ctx {
   // last dt_compose_plan / dt_compose_linear results
   std::vector<ComposedOut> composed;
   std::vector<std::pair<i64, i64>> linear_pieces;
+  // transform() metadata for dt_merge_into_doc's fast doc assembly:
+  // out[0..ff_split) are the FF-mode untransformed ops; zone_ff_base is
+  // true when the conflict zone's phase-0 seed set was empty (every
+  // forward merge / checkout), i.e. the underwater id space tiles
+  // exactly the rope state after the FF ops.
+  size_t ff_split = 0;
+  bool zone_ff_base = false;
+  // last dt_encode_full result
+  std::vector<u8> enc_buf;
 };
 
 // Feed one span's op runs through a composer (mirror of
@@ -2286,6 +2296,8 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->out.clear();
   c->last_tracker.reset();
   c->last_collisions = 0;
+  c->ff_split = 0;
+  c->zone_ff_base = false;
   std::vector<Span> new_ops, conflict_ops;
   { PROF(conflict);
     c->zone_common = c->g.find_conflicting(
@@ -2325,6 +2337,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
     }
   }
 
+  c->ff_split = c->out.size();
   if (!new_ops.empty()) {
     if (did_ff) {
       conflict_ops.clear();
@@ -2333,6 +2346,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
             if (flag != Graph::OnlyB) push_reversed_rle(conflict_ops, s);
           });
     }
+    c->zone_ff_base = conflict_ops.empty();
 
     i64 ops_top = 0;
     if (!c->ops.runs.empty()) {
@@ -2375,6 +2389,347 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
     c->last_collisions = tracker.collisions;
   }
   c->out_frontier = next_frontier;
+}
+
+// ---------------------------------------------------------------- encoder
+//
+// Native v1 full-snapshot writer (mirror of encoding/encode.py
+// encode_oplog for the from_version=[] case; format spec:
+// /root/reference/BINARY.md, reference writer src/list/encoding/
+// encode_oplog.rs). The txn walk uses this file's Zone+Walker (same
+// spanning-tree design as the Python SpanningTreeWalker); the walk order
+// may differ from the Python writer's, which changes the bytes but not
+// the decoded oplog — both writers' outputs are differential-tested
+// through decode to semantic equality. Patch encodes (from_version set)
+// stay in Python.
+
+extern "C" i64 dt_lz4_compress(const u8* src, i64 n, u8* out, i64 cap);
+extern "C" i64 dt_crc32c(const u8* data, i64 n, i64 seed);
+
+namespace enc {
+
+static const u64 CH_FILEINFO = 1, CH_DOCID = 2, CH_AGENTNAMES = 3,
+                 CH_USERDATA = 4, CH_COMPRESSED = 5, CH_STARTBRANCH = 10,
+                 CH_CONTENT_COMPRESSED = 14, CH_PATCHES = 20,
+                 CH_OP_VERSIONS = 21, CH_OP_TYPE_POS = 22,
+                 CH_OP_PARENTS = 23, CH_PATCH_CONTENT = 24,
+                 CH_CONTENT_KNOWN = 25, CH_CRC = 100;
+static const u64 DATA_PLAIN_TEXT = 4;
+
+struct Buf {
+  std::vector<u8> b;
+  void leb(u64 v) {
+    do { u8 x = v & 0x7f; v >>= 7; b.push_back(v ? (u8)(x | 0x80) : x); }
+    while (v);
+  }
+  void raw(const u8* p, size_t n) { b.insert(b.end(), p, p + n); }
+  void chunk(u64 type, const std::vector<u8>& data) {
+    leb(type); leb(data.size()); raw(data.data(), data.size());
+  }
+  void utf8(int32_t cp) {
+    u32 c = (u32)cp;
+    if (c < 0x80) b.push_back((u8)c);
+    else if (c < 0x800) {
+      b.push_back((u8)(0xC0 | (c >> 6)));
+      b.push_back((u8)(0x80 | (c & 0x3F)));
+    } else if (c < 0x10000) {
+      b.push_back((u8)(0xE0 | (c >> 12)));
+      b.push_back((u8)(0x80 | ((c >> 6) & 0x3F)));
+      b.push_back((u8)(0x80 | (c & 0x3F)));
+    } else {
+      b.push_back((u8)(0xF0 | (c >> 18)));
+      b.push_back((u8)(0x80 | ((c >> 12) & 0x3F)));
+      b.push_back((u8)(0x80 | ((c >> 6) & 0x3F)));
+      b.push_back((u8)(0x80 | (c & 0x3F)));
+    }
+  }
+};
+
+static inline u64 mix(u64 v, bool bit) { return (v << 1) | (bit ? 1 : 0); }
+static inline u64 zz(i64 v) { return mix(v < 0 ? -v : v, v < 0); }
+
+// One op run in the type/position column (encode.py _write_op).
+static void write_op(Buf& out, u8 kind, i64 start, i64 end, bool fwd,
+                     i64& cursor) {
+  i64 length = end - start;
+  fwd = fwd || length == 1;
+  i64 op_start = (kind == DEL && !fwd) ? end : start;
+  i64 op_end = (kind == INS && fwd) ? end : start;
+  i64 diff = op_start - cursor;
+  cursor = op_end;
+  u64 n;
+  if (length != 1) {
+    n = (u64)length;
+    if (kind == DEL) n = mix(n, fwd);
+  } else if (diff != 0) {
+    n = zz(diff);
+  } else {
+    n = 0;
+  }
+  n = mix(n, kind == DEL);
+  n = mix(n, diff != 0);
+  n = mix(n, length != 1);
+  out.leb(n);
+  if (length != 1 && diff != 0) out.leb(zz(diff));
+}
+
+}  // namespace enc
+
+static i64 encode_full_impl(Ctx* c, const u8* docid, i64 docid_len,
+                            const u8* userdata, i64 ud_len, bool store_ins,
+                            bool compress) {
+  using namespace enc;
+  Graph& g = c->g;
+  Agents& aa = c->aa;
+  Ops& ops = c->ops;
+  i64 top = 0;
+  if (!ops.runs.empty()) {
+    const OpRun& lr = ops.runs.back();
+    top = lr.lv + (lr.end - lr.start);
+  }
+
+  // file-local agent numbering, 1-based, in order of first use
+  std::vector<int> agent_map(aa.names.size(), 0);
+  std::vector<i64> seq_cursor(aa.names.size(), 0);
+  int next_agent = 1;
+  Buf names_buf;
+  auto map_agent = [&](i64 agent) -> int {
+    int& m = agent_map[(size_t)agent];
+    if (m == 0) {
+      m = next_agent++;
+      const std::string& nm = aa.names[(size_t)agent];
+      names_buf.leb(nm.size());
+      names_buf.raw((const u8*)nm.data(), nm.size());
+    }
+    return m;
+  };
+
+  Buf agent_chunk;
+  // pending agent run: mapped, delta, n, agent, seq_end
+  bool aa_pending = false;
+  int pa_m = 0;
+  i64 pa_delta = 0, pa_n = 0, pa_agent = 0, pa_seq_end = 0;
+  auto flush_aa = [&]() {
+    if (!aa_pending) return;
+    agent_chunk.leb(mix((u64)pa_m, pa_delta != 0));
+    agent_chunk.leb((u64)pa_n);
+    if (pa_delta != 0) agent_chunk.leb(zz(pa_delta));
+    aa_pending = false;
+  };
+
+  Buf ops_chunk;
+  i64 ops_cursor = 0;
+  bool op_pending = false;
+  OpRun pend{};
+  auto flush_op = [&]() {
+    if (!op_pending) return;
+    write_op(ops_chunk, pend.kind, pend.start, pend.end, pend.fwd,
+             ops_cursor);
+    op_pending = false;
+  };
+
+  // INS content column: utf8 chars + (len, known) RLE runs
+  Buf ins_text;
+  std::vector<std::pair<i64, bool>> ins_runs;
+  bool ins_any = false;
+  auto push_content = [&](const OpRun& piece) {
+    ins_any = true;
+    bool known = piece.cp >= 0;
+    i64 n = piece.end - piece.start;
+    if (known)
+      for (i64 k = 0; k < n; k++)
+        ins_text.utf8(c->ins_arena[(size_t)(piece.cp + k)]);
+    if (!ins_runs.empty() && ins_runs.back().second == known)
+      ins_runs.back().first += n;
+    else
+      ins_runs.emplace_back(n, known);
+  };
+
+  Buf txns_chunk;
+  // local span start -> output start (ascending by local start)
+  std::vector<i64> map_ls, map_os, map_n;
+  i64 next_output = 0;
+  auto map_local = [&](i64 p) -> i64 {
+    size_t i = (size_t)(std::upper_bound(map_ls.begin(), map_ls.end(), p) -
+                        map_ls.begin());
+    if (i == 0) return -1;
+    i--;
+    if (p >= map_ls[i] + map_n[i]) return -1;
+    return map_os[i] + (p - map_ls[i]);
+  };
+  std::vector<i64> ps;
+  auto write_txn = [&](Span span) {
+    i64 n = span.end - span.start;
+    i64 out_start = next_output;
+    size_t at = (size_t)(std::upper_bound(map_ls.begin(), map_ls.end(),
+                                          span.start) - map_ls.begin());
+    map_ls.insert(map_ls.begin() + at, span.start);
+    map_os.insert(map_os.begin() + at, out_start);
+    map_n.insert(map_n.begin() + at, n);
+    next_output += n;
+    txns_chunk.leb((u64)n);
+    g.parents_at(span.start, ps);
+    if (ps.empty()) { txns_chunk.leb(1); return; }  // foreign-ROOT marker
+    for (size_t i = 0; i < ps.size(); i++) {
+      bool has_more = i + 1 < ps.size();
+      i64 mapped = map_local(ps[i]);
+      if (mapped >= 0) {
+        txns_chunk.leb(mix(mix((u64)(out_start - mapped), has_more), false));
+      } else {
+        i64 agent, seq;
+        aa.local_to_agent(ps[i], agent, seq);
+        txns_chunk.leb(mix(mix((u64)map_agent(agent), has_more), true));
+        txns_chunk.leb((u64)seq);
+      }
+    }
+  };
+
+  // ---- main walk: whole graph as one fresh span list ----
+  if (top > 0) {
+    std::vector<Span> fresh{{0, top}};
+    Zone zone(g, {}, fresh);
+    Walker w(zone, 1);
+    std::vector<Span> retreat, advance_rev;
+    Span consume;
+    while (w.next(retreat, advance_rev, consume)) {
+      if (span_empty(consume)) continue;
+      // 1. agent assignment runs
+      i64 pos = consume.start;
+      while (pos < consume.end) {
+        i64 agent, seq;
+        aa.local_to_agent(pos, agent, seq);
+        i64 n = aa.span_len(pos, consume.end - pos);
+        int m = map_agent(agent);
+        if (aa_pending && pa_m == m && pa_seq_end == seq) {
+          pa_n += n;
+          pa_seq_end = seq + n;
+          seq_cursor[(size_t)pa_agent] = seq + n;
+        } else {
+          flush_aa();
+          i64 delta = seq - seq_cursor[(size_t)agent];
+          seq_cursor[(size_t)agent] = seq + n;
+          aa_pending = true;
+          pa_m = m; pa_delta = delta; pa_n = n; pa_agent = agent;
+          pa_seq_end = seq + n;
+        }
+        pos += n;
+      }
+      // 2. ops + content
+      size_t oi = ops.find_idx(consume.start);
+      pos = consume.start;
+      while (pos < consume.end) {
+        const OpRun& run = ops.runs[oi];
+        i64 run_end = run.lv + (run.end - run.start);
+        i64 o1 = std::min(consume.end, run_end) - run.lv;
+        OpRun piece = Ops::slice(run, pos - run.lv, o1);
+        if (piece.kind == INS && store_ins) push_content(piece);
+        i64 plen = piece.end - piece.start;
+        i64 pdlen = pend.end - pend.start;
+        bool appendable = false;
+        if (op_pending && pend.kind == piece.kind) {
+          // RLE append rule (op.py can_append_ops / op_metrics.rs:235)
+          if ((pdlen == 1 || pend.fwd) && (plen == 1 || piece.fwd)) {
+            if (piece.kind == INS && piece.start == pend.end)
+              appendable = true;
+            if (piece.kind == DEL && piece.start == pend.start)
+              appendable = true;
+          }
+          if (!appendable && piece.kind == DEL &&
+              (pdlen == 1 || !pend.fwd) && (plen == 1 || !piece.fwd) &&
+              piece.end == pend.start)
+            appendable = true;
+        }
+        if (appendable) {  // op.py append_ops
+          bool fwd = piece.start >= pend.start &&
+                     (piece.start != pend.start || piece.kind == DEL);
+          pend.fwd = fwd;
+          if (piece.kind == DEL && !fwd) pend.start = piece.start;
+          else pend.end += plen;
+        } else {
+          flush_op();
+          op_pending = true;
+          pend = piece;
+        }
+        pos = run.lv + o1;
+        oi++;
+      }
+      // 3. parents
+      write_txn(consume);
+    }
+  }
+  flush_aa();
+  flush_op();
+
+  // ---- assemble ----
+  std::vector<u8> compress_blob;
+  bool have_compressed_chunk = false;
+  Buf patches;
+  if (store_ins && ins_any) {
+    Buf body;
+    body.leb(0);  // kind = INS
+    if (compress) {
+      have_compressed_chunk = true;
+      Buf inner;
+      inner.leb(DATA_PLAIN_TEXT);
+      inner.leb(ins_text.b.size());
+      compress_blob.insert(compress_blob.end(), ins_text.b.begin(),
+                           ins_text.b.end());
+      body.chunk(CH_CONTENT_COMPRESSED, inner.b);
+    } else {
+      Buf inner;
+      inner.leb(DATA_PLAIN_TEXT);
+      inner.raw(ins_text.b.data(), ins_text.b.size());
+      body.chunk(13 /* CH_CONTENT */, inner.b);
+    }
+    Buf runs;
+    for (auto& r : ins_runs) runs.leb(mix((u64)r.first, r.second));
+    body.chunk(CH_CONTENT_KNOWN, runs.b);
+    patches.chunk(CH_PATCH_CONTENT, body.b);
+  }
+
+  Buf fileinfo;
+  if (docid_len >= 0) {
+    Buf d;
+    d.leb(DATA_PLAIN_TEXT);
+    d.raw(docid, (size_t)docid_len);
+    fileinfo.chunk(CH_DOCID, d.b);
+  }
+  fileinfo.chunk(CH_AGENTNAMES, names_buf.b);
+  if (ud_len >= 0) {
+    Buf d;
+    d.raw(userdata, (size_t)ud_len);
+    fileinfo.chunk(CH_USERDATA, d.b);
+  }
+
+  Buf result;
+  const char magic[] = "DMNDTYPS";
+  result.raw((const u8*)magic, 8);
+  result.leb(0);  // PROTOCOL_VERSION
+  if (have_compressed_chunk) {
+    Buf comp;
+    comp.leb(compress_blob.size());
+    std::vector<u8> lz(compress_blob.size() + compress_blob.size() / 8 + 64);
+    i64 ln = dt_lz4_compress(compress_blob.data(), (i64)compress_blob.size(),
+                             lz.data(), (i64)lz.size());
+    if (ln < 0) return -1;
+    comp.raw(lz.data(), (size_t)ln);
+    result.chunk(CH_COMPRESSED, comp.b);
+  }
+  result.chunk(CH_FILEINFO, fileinfo.b);
+  result.chunk(CH_STARTBRANCH, {});  // from_version = [] -> empty
+  patches.chunk(CH_OP_VERSIONS, agent_chunk.b);
+  patches.chunk(CH_OP_TYPE_POS, ops_chunk.b);
+  patches.chunk(CH_OP_PARENTS, txns_chunk.b);
+  result.chunk(CH_PATCHES, patches.b);
+
+  u32 crc = (u32)dt_crc32c(result.b.data(), (i64)result.b.size(), 0);
+  Buf crcb;
+  crcb.b.assign({(u8)(crc & 0xFF), (u8)((crc >> 8) & 0xFF),
+                 (u8)((crc >> 16) & 0xFF), (u8)((crc >> 24) & 0xFF)});
+  result.chunk(CH_CRC, crcb.b);
+
+  c->enc_buf = std::move(result.b);
+  return (i64)c->enc_buf.size();
 }
 
 // ---------------------------------------------------------------- C ABI
@@ -2453,7 +2808,11 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
   transform(c, std::vector<i64>(from, from + nf),
             std::vector<i64>(merge, merge + nm));
   PROF(doc);
-  for (const XfOp& x : c->out) {
+  size_t rope_until = c->out.size();
+  bool assemble = c->zone_ff_base && c->last_tracker != nullptr;
+  if (assemble) rope_until = c->ff_split;
+  for (size_t oi = 0; oi < rope_until; oi++) {
+    const XfOp& x = c->out[oi];
     if (x.pos < 0) continue;
     if (x.kind == INS) {
       // content chars for [lv, lv+len): arena offset via the op run's cp
@@ -2463,6 +2822,38 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
     } else {
       c->doc.erase(x.pos, x.len);
     }
+  }
+  if (assemble) {
+    // Zone portion assembled STRAIGHT FROM THE TRACKER in one in-order
+    // pass instead of per-op rope surgery: the content tree is already
+    // in merged-document order, and an item is visible at the merged
+    // version iff it was never deleted (everything in a forward merge's
+    // zone is included in the merge frontier, so upstream-visibility
+    // degenerates to !ever — same rule the device linearizer uses,
+    // diamond_types_tpu/tpu/linearize.py). Underwater ids tile the rope
+    // state after FF (zone_ff_base above); real ids pull arena content.
+    std::vector<int32_t> base((size_t)c->doc.total);
+    c->doc.dump(base.data());
+    std::vector<int32_t> fin;
+    fin.reserve(base.size() + (size_t)(c->out.size() - c->ff_split) * 4);
+    for (BLeaf* lf = c->last_tracker->first_leaf; lf; lf = lf->next)
+      for (int i = 0; i < lf->n; i++) {
+        const BEntry& e = lf->e[i];
+        if (e.ever) continue;
+        if (e.ids >= UNDERWATER) {
+          i64 p0 = e.ids - UNDERWATER;
+          if (p0 >= (i64)base.size()) continue;   // placeholder tail
+          i64 n = std::min(e.len, (i64)base.size() - p0);
+          fin.insert(fin.end(), base.begin() + p0, base.begin() + p0 + n);
+        } else {
+          const OpRun& run = c->ops.runs[c->ops.find_idx(e.ids)];
+          i64 cp = run.cp + (e.ids - run.lv);
+          fin.insert(fin.end(), c->ins_arena.data() + cp,
+                     c->ins_arena.data() + cp + e.len);
+        }
+      }
+    c->doc = TextBuf();
+    if (!fin.empty()) c->doc.insert(0, fin.data(), (i64)fin.size());
   }
   // plain merges don't need the tracker afterwards — release its O(zone)
   // tables instead of pinning them on the context (dt_transform callers
@@ -2683,6 +3074,23 @@ void dt_fetch_linear(void* p, i64* lv, i64* len) {
   }
   c->linear_pieces.clear();
   c->linear_pieces.shrink_to_fit();
+}
+
+// Native full-snapshot v1 encode (see encode_full_impl above). docid_len /
+// ud_len of -1 mean "absent". Returns the encoded size (fetch with
+// dt_encode_fetch) or -1 on failure (caller falls back to Python).
+i64 dt_encode_full(void* p, const u8* docid, i64 docid_len,
+                   const u8* userdata, i64 ud_len, i64 store_ins,
+                   i64 compress) {
+  return encode_full_impl((Ctx*)p, docid, docid_len, userdata, ud_len,
+                          store_ins != 0, compress != 0);
+}
+
+void dt_encode_fetch(void* p, u8* out) {
+  Ctx* c = (Ctx*)p;
+  std::memcpy(out, c->enc_buf.data(), c->enc_buf.size());
+  c->enc_buf.clear();
+  c->enc_buf.shrink_to_fit();
 }
 
 }  // extern "C"
