@@ -147,16 +147,14 @@ def encode_oplog(oplog: OpLog, opts: EncodeOptions = ENCODE_FULL,
         # order may differ from this writer's (bytes differ, decoded
         # oplog identical — pinned by tests/test_encode.py). Patch
         # encodes and deleted-content storage stay here.
-        import os
-        if not os.environ.get("DT_TPU_NO_NATIVE"):
-            from ..native import native_available
-            if native_available():
-                from ..native.core import get_native_ctx
-                blob = get_native_ctx(oplog).encode_full(
-                    oplog.doc_id, opts.user_data,
-                    opts.store_inserted_content, opts.compress_content)
-                if blob is not None:
-                    return blob
+        from ..native import native_ctx_or_none
+        ctx = native_ctx_or_none(oplog)
+        if ctx is not None:
+            blob = ctx.encode_full(
+                oplog.doc_id, opts.user_data,
+                opts.store_inserted_content, opts.compress_content)
+            if blob is not None:
+                return blob
     graph = oplog.cg.graph
     aa = oplog.cg.agent_assignment
 

@@ -433,16 +433,8 @@ def compose_entry(oplog, span: Tuple[int, int]) -> ComposedEntry:
 
 
 def _native_ctx_or_none(oplog):
-    """The oplog's native context, or None when the native engine is
-    disabled (DT_TPU_NO_NATIVE) or the library is unavailable."""
-    import os
-    if os.environ.get("DT_TPU_NO_NATIVE"):
-        return None
-    from ..native import native_available
-    if not native_available():
-        return None
-    from ..native.core import get_native_ctx
-    return get_native_ctx(oplog)
+    from ..native import native_ctx_or_none
+    return native_ctx_or_none(oplog)
 
 
 def _native_composed(oplog, spans) -> Optional[List[ComposedEntry]]:

@@ -9,3 +9,16 @@ built. Build with: python -m diamond_types_tpu.native.build
 
 from .core import (NativeContext, merge_native, native_available,  # noqa: F401
                    transform_native)
+
+
+def native_ctx_or_none(oplog):
+    """The oplog's native context, or None when the native engine is
+    disabled (DT_TPU_NO_NATIVE) or the library is unavailable — the one
+    gate every native fast path (composer, encoder, merge) goes through."""
+    import os
+    if os.environ.get("DT_TPU_NO_NATIVE"):
+        return None
+    if not native_available():
+        return None
+    from .core import get_native_ctx
+    return get_native_ctx(oplog)

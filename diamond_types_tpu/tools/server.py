@@ -165,14 +165,33 @@ class DocStore:
                 for d in due:
                     del self.dirty[d]
                     ol = self.docs.get(d)
-                    if ol is not None:
+                    if ol is None:
+                        continue
+                    try:
                         blobs.append((d, encode_oplog(ol, ENCODE_FULL)))
+                    except Exception:
+                        # One unencodable doc (e.g. poisoned before input
+                        # validation existed) must not abort the pass and
+                        # silently drop OTHER docs' dirty flags; re-mark
+                        # it so the failure stays visible to retries.
+                        self.dirty[d] = now
             for doc_id, blob in blobs:
                 path = self._path(doc_id)
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(blob)
                 os.replace(tmp, path)  # atomic
+
+
+def _utf8_clean(s: str) -> bool:
+    """JSON happily delivers lone surrogates ("\\ud800"); they pass str
+    checks but blow up every later encode (utf-8 wire, utf-32 arenas),
+    so one accepted op would poison persistence for the whole store."""
+    try:
+        s.encode("utf8")
+        return True
+    except UnicodeEncodeError:
+        return False
 
 
 def _crdt_next_seq(aa, agent: int) -> int:
@@ -196,6 +215,8 @@ def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     store.lock, stalling every other endpoint."""
     from operator import index as _ix
     name = str(op["agent"])
+    if not name or not _utf8_clean(name):
+        raise ValueError("bad agent name")
     seq = _ix(op["seq"])
     aa = ol.cg.agent_assignment
     # Resolve WITHOUT creating: a rejected op must not leave the agent
@@ -226,7 +247,8 @@ def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     if op.get("kind") == "ins":
         pos = _ix(op["pos"])
         content = op.get("content")
-        if not (isinstance(content, str) and content):
+        if not (isinstance(content, str) and content
+                and _utf8_clean(content)):
             raise ValueError("bad ins content")
         if not 0 <= pos <= blen:
             raise ValueError(f"ins pos {pos} out of range 0..{blen}")
@@ -473,7 +495,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     ops.append(("del", _ix(op["start"]), _ix(op["end"])))
                 else:
                     return self._send(400, b'{"error": "bad op"}')
-            if not isinstance(req.get("agent"), str) or not req["agent"]:
+            if not isinstance(req.get("agent"), str) or not req["agent"] \
+                    or not _utf8_clean(req["agent"]):
                 return self._send(400, b'{"error": "bad agent"}')
             with self.store.lock:
                 frontier = list(ol.cg.remote_to_local_frontier(
@@ -486,6 +509,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                     if op[0] == "ins":
                         _k, pos, text = op
                         if not (isinstance(text, str) and text
+                                and _utf8_clean(text)
                                 and 0 <= pos <= blen):
                             return self._send(400, b'{"error": "bad op"}')
                         blen += len(text)

@@ -8,6 +8,8 @@ from diamond_types_tpu.causalgraph.stochastic_summary import (
 from diamond_types_tpu.db import shelf
 from diamond_types_tpu.utils.stats import oplog_stats, peak_memory_probe
 from tests.test_encode import build_random_oplog
+import pytest
+
 from tests.test_fuzz import random_edit
 
 
@@ -234,3 +236,60 @@ def test_conflicts_incremental_frontier():
     assert ol.has_conflicts_when_merging([])        # from scratch: collide
     assert ol.has_conflicts_when_merging(va)        # folding B into A's doc
     assert not ol.has_conflicts_when_merging(list(ol.version))  # no-op
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_astral_wchar_fuzz_roundtrip(seed):
+    """Unicode-heavy fuzz across the wchar (UTF-16) interop endpoints:
+    concurrent astral-char edits must survive encode -> decode -> merge,
+    and every wchar position must round-trip (reference: the
+    wchar_conversion feature, branch.rs insert_at_wchar; fuzz alphabet
+    src/list_fuzzer_tools.rs:18-24)."""
+    import random
+    from diamond_types_tpu.core.unicount import (chars_to_wchars,
+                                                 count_utf16,
+                                                 wchars_to_chars)
+    from diamond_types_tpu.encoding.decode import decode_into, load_oplog
+    from diamond_types_tpu.encoding.encode import ENCODE_FULL, encode_oplog
+    from tests.test_fuzz import ALPHABET
+    from diamond_types_tpu import ListCRDT
+    rng = random.Random(1000 + seed)
+    c = ListCRDT()
+    a = c.get_or_create_agent_id("astral")
+    # seed text dense with astral chars (each = 2 wchar units)
+    seed_text = "".join(rng.choice(ALPHABET) for _ in range(40))
+    c.insert(a, 0, seed_text)
+    # wchar-addressed edits: only at positions that don't split pairs
+    for _ in range(30):
+        snap = c.branch.snapshot()
+        wpos = chars_to_wchars(snap, rng.randint(0, len(snap)))
+        if rng.random() < 0.6 or not snap:
+            c.branch.insert_at_wchar(c.oplog, a, wpos,
+                                     rng.choice(ALPHABET))
+        else:
+            cpos = wchars_to_chars(snap, wpos)
+            if cpos < len(snap):
+                wend = chars_to_wchars(snap, cpos + 1)
+                c.branch.delete_at_wchar(c.oplog, a, wpos, wend)
+    # encode -> fresh replica -> concurrent branch edits -> cross merge
+    blob = encode_oplog(c.oplog, ENCODE_FULL)
+    d = ListCRDT()
+    decode_into(d.oplog, blob)
+    d.branch = d.oplog.checkout_tip()
+    b = d.get_or_create_agent_id("bob")
+    snap = d.branch.snapshot()
+    d.branch.insert_at_wchar(d.oplog, b, chars_to_wchars(snap, len(snap) // 2),
+                             "\U00010190X\U0001019a")
+    c.insert(a, 0, "\U00010194")
+    # merge both ways; snapshots must agree and wchar maps must invert
+    blob_c = encode_oplog(c.oplog, ENCODE_FULL)
+    blob_d = encode_oplog(d.oplog, ENCODE_FULL)
+    decode_into(c.oplog, blob_d)
+    decode_into(d.oplog, blob_c)
+    sc = c.oplog.checkout_tip().snapshot()
+    sd = d.oplog.checkout_tip().snapshot()
+    assert sc == sd
+    for cpos in range(len(sc) + 1):
+        w = chars_to_wchars(sc, cpos)
+        assert wchars_to_chars(sc, w) == cpos
+    assert count_utf16(sc) == len(sc) + sum(1 for ch in sc if ord(ch) > 0xFFFF)

@@ -150,7 +150,7 @@ def _oracle_text(ops):
     return ol.checkout_tip().snapshot()
 
 
-ALPHABET = "abcdefgh XY12"
+ALPHABET = "abcdefgh XY12\u00a9\u0394\u2190\U00010190"  # incl. BMP + astral
 
 
 @pytest.mark.parametrize("seed", range(30))

@@ -10,7 +10,16 @@ import pytest
 from diamond_types_tpu import ListCRDT, OpLog
 from diamond_types_tpu.text.crdt import merge_oplogs
 
-ALPHABET = "abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|"
+# Unicode-heavy alphabet mirroring the reference's fuzzer charset
+# (reference: src/list_fuzzer_tools.rs:18-24 — ASCII, Latin-1, Greek,
+# arrows, and ASTRAL ancient-roman symbols): exercises the UTF-32 content
+# arenas, UTF-8 encode/decode columns, and the wchar (UTF-16) interop
+# maps, where surrogate-pair chars occupy two wchar units.
+ALPHABET = ("abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|\n"
+            "©¥½"              # Latin-1 supplement
+            "ΎΔδϠ"        # Greek
+            "←↯↻⇈"        # arrows
+            "\U00010190\U00010194\U00010198\U0001019a")  # astral (roman)
 
 
 def random_edit(rng, oplog, agent, version, content):

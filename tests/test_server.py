@@ -587,3 +587,42 @@ def test_crdt_ops_minimal_frontier_stored(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_crdt_ops_rejects_lone_surrogates():
+    """JSON delivers lone surrogates; accepting one poisons every later
+    encode (utf-8 wire / utf-32 arena) and breaks the flush pass."""
+    import json
+    import urllib.error
+    import urllib.request
+    srv, base = _boot_server()
+    try:
+        def push(op):
+            body = json.dumps({"push": [op]}).encode("utf8",
+                                                     "surrogatepass")
+            req = urllib.request.Request(base + "/doc/s/ops", data=body)
+            return urllib.request.urlopen(req)
+
+        push({"agent": "ok", "seq": 0, "parents": [],
+              "kind": "ins", "pos": 0, "content": "hi"})
+        for op in (
+            {"agent": "evil", "seq": 0, "parents": [["ok", 1]],
+             "kind": "ins", "pos": 0, "content": "\ud800"},
+            {"agent": "ev\udfffil", "seq": 0, "parents": [["ok", 1]],
+             "kind": "ins", "pos": 0, "content": "x"},
+        ):
+            try:
+                push(op)
+                raise AssertionError(f"accepted surrogate op {op!r}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        store = srv.RequestHandlerClass.store
+        ol = store.get("s")
+        # the doc still encodes (flush path) and reads back
+        from diamond_types_tpu.encoding.encode import (ENCODE_FULL,
+                                                       encode_oplog)
+        encode_oplog(ol, ENCODE_FULL)
+        assert ol.checkout_tip().snapshot() == "hi"
+    finally:
+        srv.shutdown()
+        srv.server_close()

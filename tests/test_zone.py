@@ -17,7 +17,11 @@ from diamond_types_tpu import OpLog
 from diamond_types_tpu.listmerge.zone_np import zone_checkout_np
 
 BENCH_DATA = reference_path("benchmark_data")
-ALPHABET = "abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|"
+# Unicode-heavy, reference-style (src/list_fuzzer_tools.rs:18-24): BMP +
+# astral chars through the zone composer/kernel paths too.
+ALPHABET = ("abcdefghijklmnop_ XYZ123*&^%$#@!~`:;'\"|"
+            "©¥½ΎΔδϠ←↯↻⇈"
+            "\U00010190\U00010194\U00010198\U0001019a")
 
 
 def random_edit(rng, oplog, agent, version, content):
