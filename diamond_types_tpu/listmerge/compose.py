@@ -440,7 +440,8 @@ def _native_ctx_or_none(oplog):
 def _native_composed(oplog, spans) -> Optional[List[ComposedEntry]]:
     """Run the C++ composer (native/dt_core.cpp Composer — same piece-
     table semantics, ~20x faster); None when unavailable/unsupported."""
-    ctx = _native_ctx_or_none(oplog)
+    from ..native import native_ctx_or_none
+    ctx = native_ctx_or_none(oplog)
     if ctx is None:
         return None
     rows = ctx.compose_plan(spans)
@@ -463,8 +464,9 @@ def assemble_prefix(oplog, ff_spans) -> str:
     composition over an empty base reconstructs the text directly from the
     insert arena (reference equivalent: the FF-mode streaming of
     merge.rs:792-859, minus the tracker)."""
+    from ..native import native_ctx_or_none
     spans = sorted(ff_spans)
-    ctx = _native_ctx_or_none(oplog)
+    ctx = native_ctx_or_none(oplog)
     if ctx is not None:
         res = ctx.compose_linear(spans)
         if res is not None:

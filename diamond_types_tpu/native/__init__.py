@@ -14,7 +14,9 @@ from .core import (NativeContext, merge_native, native_available,  # noqa: F401
 def native_ctx_or_none(oplog):
     """The oplog's native context, or None when the native engine is
     disabled (DT_TPU_NO_NATIVE) or the library is unavailable — the one
-    gate every native fast path (composer, encoder, merge) goes through."""
+    gate for every native fast path that needs a per-oplog context
+    (composer, encoder, merge, conflict counting). The fresh-load decoder
+    gates separately (no oplog exists yet at decode time)."""
     import os
     if os.environ.get("DT_TPU_NO_NATIVE"):
         return None

@@ -116,17 +116,15 @@ class Branch:
             self.content = Rope(text)
             self.version = list(frontier)
             return
-        if not os.environ.get("DT_TPU_NO_NATIVE"):
-            from ..native import merge_native, native_available
-            if native_available():
-                from ..native.core import get_native_ctx
-                doc, frontier = merge_native(oplog, self.snapshot(),
-                                             self.version, merge_frontier)
-                self.content = Rope(doc)
-                self.version = frontier
-                self.last_merge_collisions = \
-                    get_native_ctx(oplog).last_collisions()
-                return
+        from ..native import merge_native, native_ctx_or_none
+        ctx = native_ctx_or_none(oplog)
+        if ctx is not None:
+            doc, frontier = merge_native(oplog, self.snapshot(),
+                                         self.version, merge_frontier)
+            self.content = Rope(doc)
+            self.version = frontier
+            self.last_merge_collisions = ctx.last_collisions()
+            return
 
         xf = oplog.get_xf_operations_full(self.version, merge_frontier)
         self._apply_xf(oplog, xf)

@@ -232,14 +232,12 @@ class OpLog:
         merge = list(self.version) if merge_frontier is None \
             else list(merge_frontier)
         frm = [int(x) for x in from_frontier]
-        if not os.environ.get("DT_TPU_NO_NATIVE"):
-            from ..native import native_available
-            if native_available():
-                from ..native.core import get_native_ctx
-                ctx = get_native_ctx(self)
-                ctx.transform(frm, merge)
-                ctx.release_tracker()
-                return ctx.last_collisions()
+        from ..native import native_ctx_or_none
+        ctx = native_ctx_or_none(self)
+        if ctx is not None:
+            ctx.transform(frm, merge)
+            ctx.release_tracker()
+            return ctx.last_collisions()
         xf = self.get_xf_operations_full(frm, merge)
         for _ in xf:
             pass

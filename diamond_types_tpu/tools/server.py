@@ -54,6 +54,7 @@ import argparse
 import json
 import os
 import re
+import sys
 import threading
 import time
 import urllib.request
@@ -173,7 +174,12 @@ class DocStore:
                         # One unencodable doc (e.g. poisoned before input
                         # validation existed) must not abort the pass and
                         # silently drop OTHER docs' dirty flags; re-mark
-                        # it so the failure stays visible to retries.
+                        # it so the failure stays visible to retries, and
+                        # leave a diagnostic trail for operators.
+                        import traceback
+                        print(f"flush: encode failed for doc {d!r}:",
+                              file=sys.stderr)
+                        traceback.print_exc()
                         self.dirty[d] = now
             for doc_id, blob in blobs:
                 path = self._path(doc_id)
@@ -214,8 +220,8 @@ def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     queued ops would run O(ops x history) Branch merges under
     store.lock, stalling every other endpoint."""
     from operator import index as _ix
-    name = str(op["agent"])
-    if not name or not _utf8_clean(name):
+    name = op["agent"]
+    if not (isinstance(name, str) and name and _utf8_clean(name)):
         raise ValueError("bad agent name")
     seq = _ix(op["seq"])
     aa = ol.cg.agent_assignment

@@ -509,3 +509,17 @@ setInterval(syncOnce, 1200);
 syncOnce().then(rerender);
 </script>
 """
+
+_ENGINE_START = "// ---- the engine: a unit-op text CRDT"
+_ENGINE_END = "// ---- UI + sync"
+
+
+def crdt_engine_js() -> str:
+    """The in-browser CRDT ENGINE source exactly as shipped (the slice of
+    CRDT_HTML between the engine and UI markers) — the single source the
+    golden conformance fixture is generated from and checksummed against
+    (tests/data/crdt_client_golden.json; regenerate with
+    python -m tests.gen_crdt_golden after any engine edit)."""
+    start = CRDT_HTML.index(_ENGINE_START)
+    end = CRDT_HTML.index(_ENGINE_END)
+    return CRDT_HTML[start:end]

