@@ -376,8 +376,8 @@ def entry_frontier(graph, plan: MergePlan2, k: int) -> List[int]:
 def texts_at_versions(oplog, entries: Sequence[int],
                       from_frontier: Sequence[int] = (),
                       source: str = "python",
-                      merge_frontier: Optional[Sequence[int]] = None
-                      ) -> List[str]:
+                      merge_frontier: Optional[Sequence[int]] = None,
+                      version_sharding=None) -> List[str]:
     """Materialize the document at many historical versions (one per
     snapshot entry) in a single vmapped device call.
 
@@ -385,7 +385,9 @@ def texts_at_versions(oplog, entries: Sequence[int],
     a full tracker replay (src/list/oplog.rs:32). Here one device tape
     replay yields every version's state row, and one batched materialize
     gathers each document as a visibility mask over the shared final-order
-    linearization."""
+    linearization. `version_sharding` (a jax.sharding.NamedSharding over
+    the snapshot axis) spreads the materialize batch over a device mesh
+    (the version axis is padded up to the mesh when needed)."""
     import jax
     import jax.numpy as jnp
 
@@ -413,13 +415,22 @@ def texts_at_versions(oplog, entries: Sequence[int],
                             oplog, np.where(uw, 0, sid))).astype(np.int32)
 
     vis = np.where(rows == 1, text_len[None, :], 0).astype(np.int32)
+    n_real = vis.shape[0]
     cap = _pow2(max(1, int(vis.sum(axis=1).max())))
     fn = _materialize_jit_cache.get(cap)
     if fn is None:
         fn = jax.jit(jax.vmap(partial(materialize_jax, cap=cap),
                               in_axes=(None, 0, None, None)))
         _materialize_jit_cache[cap] = fn
-    texts, totals = fn(jnp.asarray(tape.perm), jnp.asarray(vis),
+    vis_dev = jnp.asarray(vis)
+    if version_sharding is not None:
+        n_mesh = int(np.prod(list(version_sharding.mesh.shape.values())))
+        pad = (-n_real) % n_mesh
+        if pad:
+            vis_dev = jnp.concatenate(
+                [vis_dev, jnp.zeros((pad, vis.shape[1]), jnp.int32)])
+        vis_dev = jax.device_put(vis_dev, version_sharding)
+    texts, totals = fn(jnp.asarray(tape.perm), vis_dev,
                        jnp.asarray(char_off),
                        jnp.asarray(arena if len(arena) else
                                    np.zeros(1, np.int32)))

@@ -486,11 +486,15 @@ _zone_batch_jit_cache = {}
 
 
 def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
-                           seq_k: np.ndarray, batch: int):
+                           seq_k: np.ndarray, batch: int,
+                           replica_sharding=None):
     """Batched replica execution: ONE shared tape, `batch` independent
     state evolutions (the many-docs-per-chip deployment shape — BASELINE
     config 4). seq keys are materialized per replica so every row is a
     real computation, not a broadcast the compiler can collapse.
+    `replica_sharding` (a jax.sharding.NamedSharding over the replica
+    axis) spreads the batch over a device mesh; jit partitions the whole
+    evolution from the input placement.
     Returns (rank [B, W], ever [B, W]) as numpy arrays."""
     import jax
     import jax.numpy as jnp
@@ -508,9 +512,11 @@ def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
         _zone_batch_jit_cache[key] = fn
     xs = _pad_tape_xs(tape)
     xs = {k: jnp.asarray(v) for k, v in xs.items()}
-    seq_b = np.broadcast_to(seq_k.astype(np.int32), (batch, W)).copy()
-    rank, ever = fn(xs, jnp.asarray(agent_k.astype(np.int32)),
-                    jnp.asarray(seq_b))
+    seq_b = jnp.asarray(
+        np.broadcast_to(seq_k.astype(np.int32), (batch, W)).copy())
+    if replica_sharding is not None:
+        seq_b = jax.device_put(seq_b, replica_sharding)
+    rank, ever = fn(xs, jnp.asarray(agent_k.astype(np.int32)), seq_b)
     return rank, ever   # DEVICE arrays: callers np.asarray (or slice) them
 
 

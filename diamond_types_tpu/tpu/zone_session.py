@@ -93,11 +93,17 @@ class DeviceZoneSession:
 
     def __init__(self, oplog, n_rows: int = 8, headroom: float = 2.0,
                  max_blocks: int = 4, max_chars: int = 256,
-                 max_dels: int = 8):
+                 max_dels: int = 8, row_sharding=None):
         self.oplog = oplog
         self.n_rows = n_rows
         self.headroom = headroom
         self.MB, self.MC, self.MD = max_blocks, max_chars, max_dels
+        # Multi-chip: a jax.sharding.NamedSharding for the version-row
+        # axis of the session state — rows (tracked branches) spread over
+        # the mesh; per-slot arrays are replicated. jit propagates the
+        # placement through every micro-tape continuation, and donation
+        # keeps it across syncs.
+        self.row_sharding = row_sharding
         self.resyncs = -1          # first build counts up to 0
         self.merges = 0
         self._lru: Dict[Tuple[int, ...], int] = {}
@@ -148,6 +154,10 @@ class DeviceZoneSession:
         fn = _micro_fn(W_cap, prep.plen, n_rows, self.MB, self.MC,
                        self.MD, _pow2(tape.op.shape[0]))
         carry = init_zone_carry(W_cap, prep.plen, n_rows, agent_k, seq_k)
+        if self.row_sharding is not None:
+            import jax
+            carry = (jax.device_put(carry[0], self.row_sharding),) \
+                + tuple(carry[1:])
         xs = {k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}
         self.carry = fn(carry, xs)
 
