@@ -1,0 +1,98 @@
+"""Measured merge-engine selection policy.
+
+`Branch.merge` keeps several interchangeable engines behind one seam
+(reference: the listmerge/listmerge2 seam, src/list/merge.rs:63-96). The
+tracker engine wins every single-doc host merge measured so far
+(BASELINE.md); the zone engine wins when merges amortize over batched
+replicas on a real accelerator. Rather than hard-coding that belief (or
+hiding it behind env vars only), the policy CHOOSES from measured
+throughput: every engine run records (ops, seconds), and the zone engine
+is selected only when its observed rate actually exceeds the tracker's
+for the workload shape. Env overrides (DT_TPU_ZONE / DT_TPU_PLAN2 /
+DT_TPU_DEVICE_MERGE / DT_TPU_NO_NATIVE) still force a specific engine —
+they are development switches, not the policy.
+
+The tracker stays the correctness oracle either way: the policy boundary
+is differential-tested (tests/test_zone.py) so a selection flip can never
+change merged text.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+TRACKER = "tracker"
+ZONE = "zone"
+
+
+class EnginePolicy:
+    """Rolling throughput record per engine; selection by measured rate.
+
+    Rates are recorded per workload shape bucket ("single" for one-doc
+    merges, "batched" for replica batches) because the zone engine's
+    economics differ entirely between them (per-call latency vs aggregate
+    throughput).
+
+    Selection properties:
+      * the TRACKER is chosen until BOTH engines have measurements — the
+        zone engine is never started spontaneously (its first run comes
+        from the bench's device phase, a session, or DT_TPU_ZONE), so a
+        merge can never be the thing that first initializes an
+        accelerator backend;
+      * once both are measured, every PROBE_EVERY-th call runs the
+        currently-losing engine so both rates stay fresh and a flip can
+        self-correct (without this, the winner would starve the loser of
+        measurements forever);
+      * accumulators decay (halved past DECAY_SECONDS) so a regression
+        is not hidden under hours of stale history.
+    """
+
+    PROBE_EVERY = 16
+    DECAY_SECONDS = 60.0
+
+    def __init__(self) -> None:
+        # (engine, shape) -> [total_ops, total_seconds]
+        self._acc: Dict[Tuple[str, str], list] = {}
+        self._calls = 0
+
+    def record(self, engine: str, shape: str, n_ops: int,
+               seconds: float) -> None:
+        if seconds <= 0 or n_ops <= 0:
+            # 0-op timings (e.g. a fork merge whose frontier-top proxy
+            # under-counts) would add pure denominator and corrupt the
+            # rate; skip them
+            return
+        acc = self._acc.setdefault((engine, shape), [0.0, 0.0])
+        acc[0] += n_ops
+        acc[1] += seconds
+        if acc[1] > self.DECAY_SECONDS:
+            acc[0] *= 0.5
+            acc[1] *= 0.5
+
+    def rate(self, engine: str, shape: str):
+        acc = self._acc.get((engine, shape))
+        if acc is None or acc[1] <= 0:
+            return None
+        return acc[0] / acc[1]
+
+    def choose(self, shape: str = "single") -> str:
+        """The engine with the best MEASURED rate for this shape; the
+        tracker wherever evidence is missing (it is the oracle and the
+        measured winner on every host workload to date)."""
+        zr = self.rate(ZONE, shape)
+        tr = self.rate(TRACKER, shape)
+        if zr is None or tr is None:
+            return TRACKER
+        self._calls += 1
+        best = ZONE if zr > tr else TRACKER
+        if self._calls % self.PROBE_EVERY == 0:
+            return TRACKER if best == ZONE else ZONE   # refresh the loser
+        return best
+
+    def snapshot(self) -> dict:
+        """Observability: measured rates per (engine, shape)."""
+        return {f"{e}/{s}": round(a[0] / a[1])
+                for (e, s), a in self._acc.items() if a[1] > 0}
+
+
+GLOBAL = EnginePolicy()

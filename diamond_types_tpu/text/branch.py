@@ -15,7 +15,8 @@ from .oplog import OpLog
 
 
 class Branch:
-    __slots__ = ("version", "content", "last_merge_collisions")
+    __slots__ = ("version", "content", "last_merge_collisions",
+                 "last_merge_engine")
 
     def __init__(self) -> None:
         self.version: List[int] = []
@@ -25,6 +26,8 @@ class Branch:
         # src/list/merge.rs:51). None = the selected engine doesn't report
         # (plan2/device tiers); 0 = merged cleanly.
         self.last_merge_collisions: Optional[int] = None
+        # which engine the policy picked for the last merge()
+        self.last_merge_engine: Optional[str] = None
 
     def __len__(self) -> int:
         return len(self.content)
@@ -90,14 +93,24 @@ class Branch:
             indexes, execute against the dense state matrix — the
             listmerge2 design; listmerge/plan2.py + dense.py),
           * DT_TPU_NO_NATIVE=1 — pure-Python engine (the oracle).
+
+        Without an env override, the ZONE engine is auto-selected when
+        the measured policy (listmerge/policy.py) says its observed
+        throughput beats the tracker's for single-doc merges — engine
+        selection is measured, not belief; the tracker remains the
+        default and the oracle.
         """
+        import time as _time
+
         self.last_merge_collisions = None
+        self.last_merge_engine = None
         if os.environ.get("DT_TPU_PLAN2"):
             from ..listmerge.dense import merge_via_plan2
             rows, final = merge_via_plan2(oplog, self.version,
                                           merge_frontier)
             self._apply_xf(oplog, rows)
             self.version = list(final)
+            self.last_merge_engine = "plan2"
             return
         if os.environ.get("DT_TPU_DEVICE_MERGE"):
             from ..tpu.merge_kernel import merge_device
@@ -105,8 +118,15 @@ class Branch:
                                           merge_frontier)
             self.content = Rope(text)
             self.version = frontier
+            self.last_merge_engine = "device"
             return
-        if os.environ.get("DT_TPU_ZONE"):
+
+        def _top(v):
+            return max((int(x) for x in v), default=-1) + 1
+
+        from ..listmerge import policy as _policy
+
+        def _zone_merge():
             # the round-3 zone engine: host composes, device (or the
             # NumPy oracle under JAX_PLATFORMS=cpu) resolves every origin
             # against state rows — no tracker anywhere
@@ -115,21 +135,48 @@ class Branch:
                                                   merge_frontier)
             self.content = Rope(text)
             self.version = list(frontier)
-            return
-        from ..native import merge_native, native_ctx_or_none
-        ctx = native_ctx_or_none(oplog)
-        if ctx is not None:
+            self.last_merge_engine = _policy.ZONE
+
+        def _tracker_merge(ctx):
+            from ..native import merge_native
             doc, frontier = merge_native(oplog, self.snapshot(),
                                          self.version, merge_frontier)
             self.content = Rope(doc)
             self.version = frontier
             self.last_merge_collisions = ctx.last_collisions()
+            self.last_merge_engine = _policy.TRACKER
+
+        if os.environ.get("DT_TPU_ZONE"):   # explicit dev override
+            n_before = _top(self.version)
+            t0 = _time.perf_counter()
+            _zone_merge()
+            _policy.GLOBAL.record(_policy.ZONE, "single",
+                                  _top(self.version) - n_before,
+                                  _time.perf_counter() - t0)
+            return
+        from ..native import native_ctx_or_none
+        ctx = native_ctx_or_none(oplog)
+        if ctx is not None:
+            # fully-default path: measured policy decides (zone is never
+            # chosen before it has measurements — see policy.py)
+            engine = _policy.GLOBAL.choose("single")
+            n_before = _top(self.version)
+            t0 = _time.perf_counter()
+            if engine == _policy.ZONE:
+                _zone_merge()
+            else:
+                _tracker_merge(ctx)
+            _policy.GLOBAL.record(engine, "single",
+                                  _top(self.version) - n_before,
+                                  _time.perf_counter() - t0)
             return
 
+        # DT_TPU_NO_NATIVE / no library: the pure-Python oracle, always
         xf = oplog.get_xf_operations_full(self.version, merge_frontier)
         self._apply_xf(oplog, xf)
         self.version = list(xf.next_frontier)
         self.last_merge_collisions = xf.collisions
+        self.last_merge_engine = "python"
 
     def _apply_xf(self, oplog: OpLog, rows) -> None:
         """Apply an (lv, op, xf_pos|None) stream to this branch's content —

@@ -2834,8 +2834,24 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
     // state after FF (zone_ff_base above); real ids pull arena content.
     std::vector<int32_t> base((size_t)c->doc.total);
     c->doc.dump(base.data());
-    std::vector<int32_t> fin;
-    fin.reserve(base.size() + (size_t)(c->out.size() - c->ff_split) * 4);
+    // two passes: exact-size the buffer, then raw copies (entries are
+    // tiny on fragmented histories; per-entry vector bookkeeping costs
+    // as much as the copy itself)
+    i64 total = 0;
+    for (BLeaf* lf = c->last_tracker->first_leaf; lf; lf = lf->next)
+      for (int i = 0; i < lf->n; i++) {
+        const BEntry& e = lf->e[i];
+        if (e.ever) continue;
+        if (e.ids >= UNDERWATER) {
+          i64 p0 = e.ids - UNDERWATER;
+          if (p0 >= (i64)base.size()) continue;   // placeholder tail
+          total += std::min(e.len, (i64)base.size() - p0);
+        } else {
+          total += e.len;
+        }
+      }
+    std::vector<int32_t> fin((size_t)total);
+    int32_t* dst = fin.data();
     for (BLeaf* lf = c->last_tracker->first_leaf; lf; lf = lf->next)
       for (int i = 0; i < lf->n; i++) {
         const BEntry& e = lf->e[i];
@@ -2844,12 +2860,13 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
           i64 p0 = e.ids - UNDERWATER;
           if (p0 >= (i64)base.size()) continue;   // placeholder tail
           i64 n = std::min(e.len, (i64)base.size() - p0);
-          fin.insert(fin.end(), base.begin() + p0, base.begin() + p0 + n);
+          std::memcpy(dst, base.data() + p0, (size_t)n * 4);
+          dst += n;
         } else {
           const OpRun& run = c->ops.runs[c->ops.find_idx(e.ids)];
           i64 cp = run.cp + (e.ids - run.lv);
-          fin.insert(fin.end(), c->ins_arena.data() + cp,
-                     c->ins_arena.data() + cp + e.len);
+          std::memcpy(dst, c->ins_arena.data() + cp, (size_t)e.len * 4);
+          dst += e.len;
         }
       }
     c->doc = TextBuf();

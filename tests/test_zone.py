@@ -190,3 +190,60 @@ def test_native_composer_matches_python(corpus):
         got = "".join(ol.ops.content_slice(int(lv), int(ln))
                       for lv, ln in zip(lvs, lens))
         assert got == expected
+
+
+def test_engine_policy_boundary_differential():
+    """Engine selection is measured policy (VERDICT r3 #8): Branch.merge
+    auto-selects the zone engine exactly when its recorded throughput
+    beats the tracker's — and a selection flip can never change merged
+    text (the tracker stays the oracle on both sides of the boundary)."""
+    from diamond_types_tpu.listmerge import policy
+    from diamond_types_tpu.native import native_available
+    from diamond_types_tpu.text.branch import Branch
+    if not native_available() or os.environ.get("DT_TPU_NO_NATIVE"):
+        pytest.skip("policy arbitrates native engines; oracle-only env")
+
+    rng = random.Random(31)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("pa", "pb")]
+    branches = [([], "")]
+    for _ in range(50):
+        bi = rng.randrange(len(branches))
+        v, c = branches[bi]
+        v, c = random_edit(rng, ol, agents[rng.randrange(2)], v, c)
+        if rng.random() < 0.3 and len(branches) < 4:
+            branches.append((v, c))
+        else:
+            branches[bi] = (v, c)
+
+    saved = policy.GLOBAL
+    try:
+        # measured-tracker-wins side of the boundary
+        policy.GLOBAL = policy.EnginePolicy()
+        policy.GLOBAL.record(policy.TRACKER, "single", 10_000, 0.001)
+        policy.GLOBAL.record(policy.ZONE, "single", 10_000, 1.0)
+        b1 = Branch()
+        b1.merge(ol, ol.version)
+        assert b1.last_merge_engine == policy.TRACKER
+        oracle = b1.snapshot()
+
+        # measured-zone-wins side: same merge, flipped selection
+        policy.GLOBAL = policy.EnginePolicy()
+        policy.GLOBAL.record(policy.TRACKER, "single", 10_000, 1.0)
+        policy.GLOBAL.record(policy.ZONE, "single", 10_000, 0.001)
+        b2 = Branch()
+        b2.merge(ol, ol.version)
+        assert b2.last_merge_engine == policy.ZONE
+        assert b2.snapshot() == oracle, \
+            "policy flip changed merged text"
+        # the zone run fed the measurement loop
+        assert policy.GLOBAL.rate(policy.ZONE, "single") is not None
+
+        # no measurements at all -> tracker (the default oracle)
+        policy.GLOBAL = policy.EnginePolicy()
+        b3 = Branch()
+        b3.merge(ol, ol.version)
+        assert b3.last_merge_engine == policy.TRACKER
+        assert b3.snapshot() == oracle
+    finally:
+        policy.GLOBAL = saved

@@ -83,11 +83,12 @@ def test_zone_kernel_friendsforever():
     assert sorted(fr) == sorted(b.version)
 
 
-@pytest.mark.skipif(not os.environ.get("DT_ZONE_KERNEL_BIG"),
-                    reason="minutes on the CPU backend; bench covers it "
-                           "on the chip (DT_ZONE_KERNEL_BIG=1 to force)")
 @pytest.mark.parametrize("corpus", ["git-makefile.dt", "node_nodecc.dt"])
 def test_zone_kernel_big_corpora(corpus):
+    """Big-corpus parity through the jitted scan IN DEFAULT CI (VERDICT
+    r3: the old skip's premise — "bench covers it on the chip" — was
+    false whenever the accelerator tunnel wedged, which was most of
+    rounds 2-3; minutes of CPU-backend scan beat zero coverage)."""
     from diamond_types_tpu.encoding.decode import load_oplog
     with open(os.path.join(BENCH_DATA, corpus), "rb") as f:
         ol = load_oplog(f.read())
