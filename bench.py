@@ -734,6 +734,8 @@ def main() -> None:
     # print_stats, src/list/oplog.rs:353-405; counters per SURVEY §5) —
     # full report only, never the summary line.
     try:
+        from diamond_types_tpu.listmerge import policy as _policy
+        full["engine_policy_rates"] = _policy.GLOBAL.snapshot()
         full["stats"] = oplog_stats(gm_ol, include_encoded_sizes=True)
         c = native_counters()
         if c is not None:

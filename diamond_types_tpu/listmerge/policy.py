@@ -6,81 +6,91 @@ tracker engine wins every single-doc host merge measured so far
 (BASELINE.md); the zone engine wins when merges amortize over batched
 replicas on a real accelerator. Rather than hard-coding that belief (or
 hiding it behind env vars only), the policy CHOOSES from measured
-throughput: every engine run records (ops, seconds), and the zone engine
-is selected only when its observed rate actually exceeds the tracker's
-for the workload shape. Env overrides (DT_TPU_ZONE / DT_TPU_PLAN2 /
+throughput. Measurements are recorded at the ENGINES (zone rates inside
+zone_checkout_device — every zone run feeds the policy no matter who
+started it: a DT_TPU_ZONE override, a bench, or the policy itself; tracker rates at the Branch.merge seam), so the policy can
+bootstrap without env flips. Env overrides (DT_TPU_ZONE / DT_TPU_PLAN2 /
 DT_TPU_DEVICE_MERGE / DT_TPU_NO_NATIVE) still force a specific engine —
 they are development switches, not the policy.
 
 The tracker stays the correctness oracle either way: the policy boundary
 is differential-tested (tests/test_zone.py) so a selection flip can never
-change merged text.
+change merged text. A policy-selected zone merge reports
+last_merge_collisions = None (the documented "engine doesn't report"
+value — same as the plan2/device overrides); callers that need conflict
+detection use OpLog.has_conflicts_when_merging.
+
+Selection properties:
+  * the TRACKER is chosen until BOTH engines have measurements — the
+    zone engine is never started spontaneously, so a merge can never be
+    the thing that first initializes an accelerator backend;
+  * once both are measured, every PROBE_EVERY-th call runs the currently
+    losing engine so both rates stay fresh and a flip self-corrects;
+  * rates decay with WALL-CLOCK half-life HALF_LIFE_S, so a regression is
+    not hidden under stale history;
+  * a zone-engine failure demotes it on the spot (forget) and the merge
+    falls back to the tracker.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+import time
+from typing import Dict
 
 TRACKER = "tracker"
 ZONE = "zone"
 
 
 class EnginePolicy:
-    """Rolling throughput record per engine; selection by measured rate.
-
-    Rates are recorded per workload shape bucket ("single" for one-doc
-    merges, "batched" for replica batches) because the zone engine's
-    economics differ entirely between them (per-call latency vs aggregate
-    throughput).
-
-    Selection properties:
-      * the TRACKER is chosen until BOTH engines have measurements — the
-        zone engine is never started spontaneously (its first run comes
-        from the bench's device phase, a session, or DT_TPU_ZONE), so a
-        merge can never be the thing that first initializes an
-        accelerator backend;
-      * once both are measured, every PROBE_EVERY-th call runs the
-        currently-losing engine so both rates stay fresh and a flip can
-        self-correct (without this, the winner would starve the loser of
-        measurements forever);
-      * accumulators decay (halved past DECAY_SECONDS) so a regression
-        is not hidden under hours of stale history.
-    """
-
     PROBE_EVERY = 16
-    DECAY_SECONDS = 60.0
+    HALF_LIFE_S = 300.0
 
     def __init__(self) -> None:
-        # (engine, shape) -> [total_ops, total_seconds]
-        self._acc: Dict[Tuple[str, str], list] = {}
+        # engine -> [ops, seconds, last_record_wall_time]
+        self._acc: Dict[str, list] = {}
         self._calls = 0
 
-    def record(self, engine: str, shape: str, n_ops: int,
-               seconds: float) -> None:
+    def _decayed(self, engine: str):
+        acc = self._acc.get(engine)
+        if acc is None:
+            return None
+        dt = time.monotonic() - acc[2]
+        if dt > 0:
+            f = 0.5 ** (dt / self.HALF_LIFE_S)
+            acc[0] *= f
+            acc[1] *= f
+            acc[2] = time.monotonic()
+        return acc
+
+    def record(self, engine: str, n_ops: int, seconds: float) -> None:
         if seconds <= 0 or n_ops <= 0:
             # 0-op timings (e.g. a fork merge whose frontier-top proxy
             # under-counts) would add pure denominator and corrupt the
             # rate; skip them
             return
-        acc = self._acc.setdefault((engine, shape), [0.0, 0.0])
+        acc = self._decayed(engine)
+        if acc is None:
+            acc = self._acc[engine] = [0.0, 0.0, time.monotonic()]
         acc[0] += n_ops
         acc[1] += seconds
-        if acc[1] > self.DECAY_SECONDS:
-            acc[0] *= 0.5
-            acc[1] *= 0.5
 
-    def rate(self, engine: str, shape: str):
-        acc = self._acc.get((engine, shape))
+    def forget(self, engine: str) -> None:
+        """Drop an engine's measurements (e.g. it just failed): the
+        policy stops choosing it until it is measured again."""
+        self._acc.pop(engine, None)
+
+    def rate(self, engine: str):
+        acc = self._decayed(engine)
         if acc is None or acc[1] <= 0:
             return None
         return acc[0] / acc[1]
 
-    def choose(self, shape: str = "single") -> str:
-        """The engine with the best MEASURED rate for this shape; the
-        tracker wherever evidence is missing (it is the oracle and the
-        measured winner on every host workload to date)."""
-        zr = self.rate(ZONE, shape)
-        tr = self.rate(TRACKER, shape)
+    def choose(self) -> str:
+        """The engine with the best MEASURED rate; the tracker wherever
+        evidence is missing (it is the oracle and the measured winner on
+        every host workload to date)."""
+        zr = self.rate(ZONE)
+        tr = self.rate(TRACKER)
         if zr is None or tr is None:
             return TRACKER
         self._calls += 1
@@ -90,9 +100,10 @@ class EnginePolicy:
         return best
 
     def snapshot(self) -> dict:
-        """Observability: measured rates per (engine, shape)."""
-        return {f"{e}/{s}": round(a[0] / a[1])
-                for (e, s), a in self._acc.items() if a[1] > 0}
+        """Observability (reported in bench_report_full.json): measured
+        ops/sec per engine."""
+        return {e: round(a[0] / a[1])
+                for e, a in self._acc.items() if a[1] > 0}
 
 
 GLOBAL = EnginePolicy()

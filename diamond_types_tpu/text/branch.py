@@ -129,7 +129,10 @@ class Branch:
         def _zone_merge():
             # the round-3 zone engine: host composes, device (or the
             # NumPy oracle under JAX_PLATFORMS=cpu) resolves every origin
-            # against state rows — no tracker anywhere
+            # against state rows — no tracker anywhere. Its throughput is
+            # recorded by zone_checkout_device itself. A policy-selected
+            # zone merge reports last_merge_collisions = None (the
+            # documented "engine doesn't report" value).
             from ..tpu.zone_kernel import zone_checkout_device
             text, frontier = zone_checkout_device(oplog, self.version,
                                                   merge_frontier)
@@ -139,36 +142,36 @@ class Branch:
 
         def _tracker_merge(ctx):
             from ..native import merge_native
+            n_before = _top(self.version)
+            t0 = _time.perf_counter()
             doc, frontier = merge_native(oplog, self.snapshot(),
                                          self.version, merge_frontier)
             self.content = Rope(doc)
             self.version = frontier
             self.last_merge_collisions = ctx.last_collisions()
             self.last_merge_engine = _policy.TRACKER
-
-        if os.environ.get("DT_TPU_ZONE"):   # explicit dev override
-            n_before = _top(self.version)
-            t0 = _time.perf_counter()
-            _zone_merge()
-            _policy.GLOBAL.record(_policy.ZONE, "single",
+            _policy.GLOBAL.record(_policy.TRACKER,
                                   _top(self.version) - n_before,
                                   _time.perf_counter() - t0)
+
+        if os.environ.get("DT_TPU_ZONE"):   # explicit dev override
+            _zone_merge()
             return
         from ..native import native_ctx_or_none
         ctx = native_ctx_or_none(oplog)
         if ctx is not None:
             # fully-default path: measured policy decides (zone is never
             # chosen before it has measurements — see policy.py)
-            engine = _policy.GLOBAL.choose("single")
-            n_before = _top(self.version)
-            t0 = _time.perf_counter()
-            if engine == _policy.ZONE:
-                _zone_merge()
-            else:
-                _tracker_merge(ctx)
-            _policy.GLOBAL.record(engine, "single",
-                                  _top(self.version) - n_before,
-                                  _time.perf_counter() - t0)
+            if _policy.GLOBAL.choose() == _policy.ZONE:
+                try:
+                    _zone_merge()
+                    return
+                except Exception:
+                    # demote the zone engine on the spot and fall back:
+                    # a failed accelerator path must never fail a merge
+                    # the tracker can do in milliseconds
+                    _policy.GLOBAL.forget(_policy.ZONE)
+            _tracker_merge(ctx)
             return
 
         # DT_TPU_NO_NATIVE / no library: the pure-Python oracle, always

@@ -156,14 +156,15 @@ class OpLog:
         ins_ln = np.where(kind == INS, ln, 0)
         coff = np.cumsum(ins_ln) - ins_ln
 
+        # one tolist() per column (C-speed int conversion) — per-element
+        # numpy scalar indexing made this loop the whole ingest cost
         runs = self.ops.runs
-        for i in range(len(firsts)):
-            k = int(g_kind[i])
-            cp = ((base + int(coff[firsts[i]]),
-                   base + int(coff[firsts[i]]) + int(g_len[i]))
-                  if k == INS else None)
-            runs.append(OpRun(int(g_lv[i]), k, int(g_start[i]),
-                              int(g_end[i]), not bool(g_back[i]), cp))
+        cp0 = (base + coff[firsts]).tolist()
+        for lv, k, st, en, back, c0, gl in zip(
+                g_lv.tolist(), g_kind.tolist(), g_start.tolist(),
+                g_end.tolist(), g_back.tolist(), cp0, g_len.tolist()):
+            runs.append(OpRun(lv, k, st, en, not back,
+                              (c0, c0 + gl) if k == INS else None))
 
         total = int(g_len.sum())
         self.cg.assign_local_op_with_parents(self.version, agent, total)

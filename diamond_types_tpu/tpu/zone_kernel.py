@@ -552,18 +552,29 @@ def zone_checkout_device(oplog, from_frontier: Sequence[int] = (),
                          prep: Optional[ZonePrep] = None,
                          tape: Optional[ZoneTape] = None):
     """Full device checkout/merge via the zone kernel. Returns
-    (text, frontier)."""
+    (text, frontier). Every run records its throughput into the engine
+    policy (listmerge/policy.py) — this is how the policy's zone rate
+    bootstraps regardless of who started the run."""
+    import time as _time
+    t0 = _time.perf_counter()
     if prep is None:
         prep = prepare_zone(oplog, from_frontier, merge_frontier)
     if not prep.plan.entries:
-        return prep.prefix, list(prep.plan.final_frontier)
-    if tape is None:
-        tape = pack_zone_tape(prep)
-    rank, ever = execute_zone_jax(tape, prep.agent_k, prep.seq_k)
-    order = np.argsort(rank, kind="stable")[:_count_live(rank)]
-    vis = ever[order] == 0
-    txt = prep.pool[order[vis]].astype(np.int32).tobytes() \
-        .decode("utf-32-le")
+        txt = prep.prefix
+    else:
+        if tape is None:
+            tape = pack_zone_tape(prep)
+        rank, ever = execute_zone_jax(tape, prep.agent_k, prep.seq_k)
+        order = np.argsort(rank, kind="stable")[:_count_live(rank)]
+        vis = ever[order] == 0
+        txt = prep.pool[order[vis]].astype(np.int32).tobytes() \
+            .decode("utf-32-le")
+    from ..listmerge import policy as _policy
+    n_before = max((int(x) for x in from_frontier), default=-1) + 1
+    n_after = max((int(x) for x in prep.plan.final_frontier),
+                  default=-1) + 1
+    _policy.GLOBAL.record(_policy.ZONE, n_after - n_before,
+                          _time.perf_counter() - t0)
     return txt, list(prep.plan.final_frontier)
 
 

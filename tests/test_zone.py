@@ -220,8 +220,8 @@ def test_engine_policy_boundary_differential():
     try:
         # measured-tracker-wins side of the boundary
         policy.GLOBAL = policy.EnginePolicy()
-        policy.GLOBAL.record(policy.TRACKER, "single", 10_000, 0.001)
-        policy.GLOBAL.record(policy.ZONE, "single", 10_000, 1.0)
+        policy.GLOBAL.record(policy.TRACKER, 10_000, 0.001)
+        policy.GLOBAL.record(policy.ZONE, 10_000, 1.0)
         b1 = Branch()
         b1.merge(ol, ol.version)
         assert b1.last_merge_engine == policy.TRACKER
@@ -229,15 +229,15 @@ def test_engine_policy_boundary_differential():
 
         # measured-zone-wins side: same merge, flipped selection
         policy.GLOBAL = policy.EnginePolicy()
-        policy.GLOBAL.record(policy.TRACKER, "single", 10_000, 1.0)
-        policy.GLOBAL.record(policy.ZONE, "single", 10_000, 0.001)
+        policy.GLOBAL.record(policy.TRACKER, 10_000, 1.0)
+        policy.GLOBAL.record(policy.ZONE, 10_000, 0.001)
         b2 = Branch()
         b2.merge(ol, ol.version)
         assert b2.last_merge_engine == policy.ZONE
         assert b2.snapshot() == oracle, \
             "policy flip changed merged text"
         # the zone run fed the measurement loop
-        assert policy.GLOBAL.rate(policy.ZONE, "single") is not None
+        assert policy.GLOBAL.rate(policy.ZONE) is not None
 
         # no measurements at all -> tracker (the default oracle)
         policy.GLOBAL = policy.EnginePolicy()
