@@ -166,10 +166,17 @@ class Branch:
                 try:
                     _zone_merge()
                     return
-                except Exception:
-                    # demote the zone engine on the spot and fall back:
-                    # a failed accelerator path must never fail a merge
-                    # the tracker can do in milliseconds
+                except Exception as e:
+                    # demote the zone engine and fall back: a failed
+                    # accelerator path must never fail a merge the
+                    # tracker can do in milliseconds. Leave a trail —
+                    # otherwise a transient blip and a persistent zone
+                    # bug both look like an unexplained slowdown.
+                    import warnings
+                    warnings.warn(
+                        f"zone engine failed ({e.__class__.__name__}: "
+                        f"{e}); demoted, falling back to the tracker",
+                        RuntimeWarning)
                     _policy.GLOBAL.forget(_policy.ZONE)
             _tracker_merge(ctx)
             return

@@ -62,31 +62,55 @@ let pollFails = 0;
 const api = (path, body) => fetch(`/doc/${DOC}/${path}`, {
   method: "POST", body: JSON.stringify(body)}).then(r => r.json());
 
+// Positions on the wire are CODE POINTS (the server's unit — the
+// reference's wchar_conversion exists because JS strings are UTF-16:
+// diffing on raw string indices would drift past any astral char and
+// could split surrogate pairs). Diff over code-point arrays instead.
+const cpOf = (s, units) => {     // UTF-16 index -> code-point position
+  let n = 0;
+  for (let k = 0; k < units; n++) k += s.codePointAt(k) > 0xFFFF ? 2 : 1;
+  return n;
+};
+const unitOf = (s, cp) => {      // code-point position -> UTF-16 index
+  let k = 0;
+  for (let n = 0; n < cp && k < s.length; n++)
+    k += s.codePointAt(k) > 0xFFFF ? 2 : 1;
+  return k;
+};
+
 // Single-edit diff: common prefix/suffix between shadow and textarea.
 function diffOps(oldS, newS) {
   if (oldS === newS) return [];
-  let p = 0, oe = oldS.length, ne = newS.length;
-  while (p < oe && p < ne && oldS[p] === newS[p]) p++;
-  while (oe > p && ne > p && oldS[oe - 1] === newS[ne - 1]) { oe--; ne--; }
+  const a = Array.from(oldS), b = Array.from(newS);
+  let p = 0, oe = a.length, ne = b.length;
+  while (p < oe && p < ne && a[p] === b[p]) p++;
+  while (oe > p && ne > p && a[oe - 1] === b[ne - 1]) { oe--; ne--; }
   const ops = [];
   if (oe > p) ops.push({kind: "del", start: p, end: oe});
-  if (ne > p) ops.push({kind: "ins", pos: p, text: newS.slice(p, ne)});
+  if (ne > p) ops.push({kind: "ins", pos: p, text: b.slice(p, ne).join("")});
   return ops;
 }
 
-function applyTraversal(text, op, cursor) {
-  let pos = 0, out = "", cur = cursor;
+function applyTraversal(text, op, cursorUnits) {
+  const chars = Array.from(text);
+  let cur = cpOf(text, cursorUnits);
+  let pos = 0;
+  const out = [];
   for (const c of op) {
-    if (typeof c === "number") { out += text.slice(pos, pos + c); pos += c; }
-    else if (typeof c === "string") {
-      if (out.length <= cur) cur += c.length;
-      out += c;
+    if (typeof c === "number") {
+      for (let i = 0; i < c; i++) out.push(chars[pos + i]);
+      pos += c;
+    } else if (typeof c === "string") {
+      const ins = Array.from(c);
+      if (out.length <= cur) cur += ins.length;
+      out.push(...ins);
     } else {
       if (out.length < cur) cur = Math.max(out.length, cur - c.d);
       pos += c.d;
     }
   }
-  return [out + text.slice(pos), cur];
+  const full = out.join("") + chars.slice(pos).join("");
+  return [full, unitOf(full, cur)];
 }
 
 function onInput() {
@@ -429,13 +453,17 @@ let shadow = "";
 function onInput() {
   const now = ta.value;
   if (now === shadow) return;
-  let p = 0, oe = shadow.length, ne = now.length;
-  while (p < oe && p < ne && shadow[p] === now[p]) p++;
-  while (oe > p && ne > p && shadow[oe - 1] === now[ne - 1]) { oe--; ne--; }
+  // Diff over CODE POINTS: positions on the wire are code points, and a
+  // raw UTF-16 index loop would push lone surrogate halves as op
+  // content for astral chars (which the server rejects).
+  const a = Array.from(shadow), b = Array.from(now);
+  let p = 0, oe = a.length, ne = b.length;
+  while (p < oe && p < ne && a[p] === b[p]) p++;
+  while (oe > p && ne > p && a[oe - 1] === b[ne - 1]) { oe--; ne--; }
   // unit deletes: removing [p, oe) one char at a time — each removal
   // shifts the next target into position p, so every unit deletes at p
   for (let x = p; x < oe; x++) localOp("del", p, null);
-  for (let x = p; x < ne; x++) localOp("ins", x, now[x]);
+  for (let x = p; x < ne; x++) localOp("ins", x, b[x]);
   shadow = now;
   st.textContent = "local edit (" + eng.unpushed + " unsynced)";
 }
@@ -474,8 +502,13 @@ async function syncOnce() {
     eng.unpushed -= push.length;
     let fresh = 0;
     for (const row of r.ops) {
-      // expand run rows into unit ops (chained parents within the run)
-      const units = row.kind === "ins" ? row.content.length : row.len;
+      // expand run rows into unit ops (chained parents within the run);
+      // CODE POINTS, not UTF-16 units — indexing row.content by unit
+      // would split astral chars into lone-surrogate ops with
+      // over-counted seqs (ops and positions are code-point-addressed
+      // everywhere on the wire)
+      const chars = row.kind === "ins" ? Array.from(row.content) : null;
+      const units = row.kind === "ins" ? chars.length : row.len;
       for (let u = 0; u < units; u++) {
         // fwd deletes repeat at the span start (each removal shifts the
         // next char in); reverse (backspace) runs walk end-1 downward
@@ -484,7 +517,7 @@ async function syncOnce() {
           parents: u === 0 ? row.parents : [[row.agent, row.seq + u - 1]],
           kind: row.kind,
           pos: row.kind === "ins" ? row.pos + u : dpos,
-          ch: row.kind === "ins" ? row.content[u] : null};
+          ch: row.kind === "ins" ? chars[u] : null};
         if (addOp(op)) fresh++;
       }
     }

@@ -557,6 +557,11 @@ def zone_checkout_device(oplog, from_frontier: Sequence[int] = (),
     bootstraps regardless of who started the run."""
     import time as _time
     t0 = _time.perf_counter()
+    # Record throughput into the engine policy only for FULL runs (prep
+    # and tape computed here): a caller passing precomputed prep/tape
+    # would otherwise feed an execute-only rate — minus the dominant
+    # compose/pack cost — into merge-engine selection.
+    full_run = prep is None and tape is None
     if prep is None:
         prep = prepare_zone(oplog, from_frontier, merge_frontier)
     if not prep.plan.entries:
@@ -569,12 +574,13 @@ def zone_checkout_device(oplog, from_frontier: Sequence[int] = (),
         vis = ever[order] == 0
         txt = prep.pool[order[vis]].astype(np.int32).tobytes() \
             .decode("utf-32-le")
-    from ..listmerge import policy as _policy
-    n_before = max((int(x) for x in from_frontier), default=-1) + 1
-    n_after = max((int(x) for x in prep.plan.final_frontier),
-                  default=-1) + 1
-    _policy.GLOBAL.record(_policy.ZONE, n_after - n_before,
-                          _time.perf_counter() - t0)
+    if full_run:
+        from ..listmerge import policy as _policy
+        n_before = max((int(x) for x in from_frontier), default=-1) + 1
+        n_after = max((int(x) for x in prep.plan.final_frontier),
+                      default=-1) + 1
+        _policy.GLOBAL.record(_policy.ZONE, n_after - n_before,
+                              _time.perf_counter() - t0)
     return txt, list(prep.plan.final_frontier)
 
 

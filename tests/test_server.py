@@ -626,3 +626,60 @@ def test_crdt_ops_rejects_lone_surrogates():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_dumb_client_astral_positions(tmp_path):
+    """Browser endpoints speak CODE-POINT positions (the fixed JS clients
+    diff over Array.from; raw UTF-16 indices would drift past astral
+    chars). The Python DumbClient has code-point semantics natively —
+    this pins the contract end to end across /edit + /changes with
+    astral content."""
+    import threading
+    from diamond_types_tpu.tools.server import serve
+    httpd = serve(port=0, data_dir=str(tmp_path))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        w1 = DumbClient(base, "astro", "web-one")
+        w1.edit([{"kind": "ins", "pos": 0,
+                  "text": "a\U0001F600b\U0001F3F4c"}])   # 5 code points
+        w2 = DumbClient(base, "astro", "web-two")
+        w2.sync()
+        assert w2.text == "a\U0001F600b\U0001F3F4c"
+        # edit AFTER the astral chars: pos 4 = before 'c' in code points
+        w2.edit([{"kind": "ins", "pos": 4, "text": "!"}])
+        w1.edit([{"kind": "del", "start": 1, "end": 2}])  # delete emoji
+        w1.sync()
+        w2.sync()
+        w1.sync()
+        assert w1.text == w2.text == "ab\U0001F3F4!c"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_crdt_peer_astral_unit_ops():
+    """The /ops peer protocol is code-point addressed: run rows expand
+    into one unit op per CODE POINT (the fixed JS pull loop uses
+    Array.from; unit-indexing would split astral chars into lone
+    surrogates with over-counted seqs)."""
+    srv, base = _boot_server()
+    try:
+        p1 = _CrdtPeer(base, "adoc", "anna")
+        p1.edit_ins(0, "x\U0001F600y")     # 3 code points, 3 unit ops
+        p1.sync()
+        p2 = _CrdtPeer(base, "adoc", "bert")
+        out = p2.sync()
+        total_units = sum(len(r.get("content") or "") if r["kind"] == "ins"
+                          else r["len"] for r in out["ops"])
+        assert total_units == 3            # not 4 UTF-16 units
+        assert p2.known["anna"] == 3       # seq accounting by code point
+        p2.edit_ins(2, "\U0001F3F4")       # insert BETWEEN emoji and y
+        p2.sync()
+        p1.sync()
+        store = srv.RequestHandlerClass.store
+        text = store.get("adoc").checkout_tip().snapshot()
+        assert text == "x\U0001F600\U0001F3F4y"
+    finally:
+        srv.shutdown()
+        srv.server_close()
