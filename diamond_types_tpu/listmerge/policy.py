@@ -7,9 +7,10 @@ tracker engine wins every single-doc host merge measured so far
 replicas on a real accelerator. Rather than hard-coding that belief (or
 hiding it behind env vars only), the policy CHOOSES from measured
 throughput. Measurements are recorded at the ENGINES (zone rates inside
-zone_checkout_device — every zone run feeds the policy no matter who
-started it: a DT_TPU_ZONE override, a bench, or the policy itself; tracker rates at the Branch.merge seam), so the policy can
-bootstrap without env flips. Env overrides (DT_TPU_ZONE / DT_TPU_PLAN2 /
+zone_checkout_device for FULL runs — whether started by a DT_TPU_ZONE
+override, a bench, or the policy itself; precomputed-prep runs are not
+recorded since they skip the dominant host cost — and tracker rates at
+the Branch.merge seam), so the policy can bootstrap without env flips. Env overrides (DT_TPU_ZONE / DT_TPU_PLAN2 /
 DT_TPU_DEVICE_MERGE / DT_TPU_NO_NATIVE) still force a specific engine —
 they are development switches, not the policy.
 

@@ -10,9 +10,11 @@ positional updates ... via operational transform"), so the browser needs no
 CRDT at all: it sends positional edits tagged with the version it saw and
 catches up by applying server-computed traversal ops (text/ot.py).
 
-Caveat (demo-scope): JS strings are UTF-16; traversal positions are unicode
-chars. Text outside the BMP would need the wchar conversion endpoints
-(core/unicount.py) — the reference wiki client has the same split.
+Positions on the wire are CODE POINTS everywhere: JS strings are UTF-16,
+so both clients diff/apply over Array.from code-point arrays and convert
+the cursor at the boundary (the reference ships wchar conversion for the
+same split; here the conversion lives client-side, pinned by the astral
+end-to-end tests in tests/test_server.py).
 """
 
 INDEX_HTML = """<!doctype html>

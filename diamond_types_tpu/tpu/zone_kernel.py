@@ -87,34 +87,58 @@ class ZoneTape:
     total_steps: int
 
 
-def entry_steps(ce, slot_fn, agent_k, seq_k, MB, MC, MD, cur, next_sub):
+def _origin_encoding(ch_kind, slots, anchor, c_of):
+    """The per-char origin-left encoding — the ONE statement of the rule
+    shared by the per-entry and whole-corpus batched column builders:
+    interior chars chain to their predecessor slot, K_OWN heads anchor on
+    an own slot, query heads (K_LEFTJOIN / K_ROOT) carry a cursor coord
+    (-1 = doc start, -2 = resolve the coord at runtime)."""
+    is_q = ch_kind >= 2
+    ol_static = np.where(
+        ch_kind == 0, slots - 1,
+        np.where(ch_kind == K_OWN, anchor,
+                 np.where(c_of == 0, -1, -2)))
+    ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
+    return ol_static, ol_coord
+
+
+def entry_columns(ce, slot_fn, agent_k, seq_k):
+    """Per-char tape columns for one composed entry: (slots, ol_static,
+    ol_coord, orr_own, ag, sq, root_slots)."""
+    slots = slot_fn(ce.ch_lv).astype(np.int64)
+    anchor = np.where(ce.ch_anchor >= 0,
+                      slot_fn(np.maximum(ce.ch_anchor, 0)), -1)
+    orr_own = np.where(ce.ch_orrown >= 0,
+                       slot_fn(np.maximum(ce.ch_orrown, 0)), -1)
+    root_slots = slot_fn(ce.blk_root_lv)
+    qc = np.asarray(ce.q_cursor, dtype=np.int64) \
+        if ce.q_cursor else np.zeros(1, np.int64)
+    c_of = qc[np.clip(ce.ch_q, 0, None)]
+    ol_static, ol_coord = _origin_encoding(np.asarray(ce.ch_kind), slots,
+                                           anchor, c_of)
+    if callable(agent_k):   # one call yields both key planes
+        ag, sq = agent_k(ce.ch_lv)
+    else:
+        ag = np.asarray(agent_k)[slots]
+        sq = np.asarray(seq_k)[slots]
+    return slots, ol_static, ol_coord, orr_own, ag, sq, root_slots
+
+
+def entry_steps(ce, slot_fn, agent_k, seq_k, MB, MC, MD, cur, next_sub,
+                cols=None):
     """Append one composed entry's APPLY sub-step contents (blocks, char
     slices, delete atoms) under the shared budgets. `slot_fn` maps insert
     LVs to char slots; `cur` is the current step dict; `next_sub()`
     returns a fresh sub-step. Shared by the whole-document packer below
-    and the incremental session packer (zone_session.py)."""
+    and the incremental session packer (zone_session.py). `cols` are
+    precomputed entry_columns (the whole-document packer batches them
+    across all entries — per-entry numpy-call overhead dominated the
+    pack on many-entry corpora)."""
     nc = ce.num_chars()
     if nc:
-        slots = slot_fn(ce.ch_lv).astype(np.int64)
-        anchor = np.where(ce.ch_anchor >= 0,
-                          slot_fn(np.maximum(ce.ch_anchor, 0)), -1)
-        orr_own = np.where(ce.ch_orrown >= 0,
-                           slot_fn(np.maximum(ce.ch_orrown, 0)), -1)
-        root_slots = slot_fn(ce.blk_root_lv)
-        qc = np.asarray(ce.q_cursor, dtype=np.int64) \
-            if ce.q_cursor else np.zeros(1, np.int64)
-        c_of = qc[np.clip(ce.ch_q, 0, None)]
-        is_q = ce.ch_kind >= 2      # K_LEFTJOIN / K_ROOT heads
-        ol_static = np.where(
-            ce.ch_kind == 0, slots - 1,
-            np.where(ce.ch_kind == K_OWN, anchor,
-                     np.where(c_of == 0, -1, -2)))
-        ol_coord = np.where(is_q & (c_of > 0), c_of, 0)
-        if callable(agent_k):   # one call yields both key planes
-            ag, sq = agent_k(ce.ch_lv)
-        else:
-            ag = np.asarray(agent_k)[slots]
-            sq = np.asarray(seq_k)[slots]
+        if cols is None:
+            cols = entry_columns(ce, slot_fn, agent_k, seq_k)
+        slots, ol_static, ol_coord, orr_own, ag, sq, root_slots = cols
     for b in range(len(ce.blk_start) if nc else 0):
         lo = int(ce.blk_start[b])
         hi = lo + int(ce.blk_len[b])
@@ -147,11 +171,75 @@ def entry_steps(ce, slot_fn, agent_k, seq_k, MB, MC, MD, cur, next_sub):
         cur["dels"].append((1, s0, s0 + (lv1 - lv0)))
 
 
+def _batched_columns(prep):
+    """entry_columns for EVERY composed entry in a few whole-corpus numpy
+    passes, returned as per-entry views. Equivalent to calling
+    entry_columns per entry (pinned by test_zone_kernel's corpora parity)
+    but ~an order of magnitude cheaper on many-entry plans."""
+    ces = prep.composed
+    # Batching trades per-entry numpy-call overhead for whole-corpus
+    # concatenation copies: a win on many-small-entry plans (git-style
+    # DAGs), a loss on few-huge-entry plans (node_nodecc's 100 entries
+    # of ~4k chars) where the copies dominate and the per-entry overhead
+    # was negligible. 200 entries is comfortably past the crossover.
+    if len(ces) < 200:
+        return {}
+    cat = np.concatenate
+    ch_lv = cat([np.asarray(ce.ch_lv, dtype=np.int64) if ce.num_chars()
+                 else np.zeros(0, np.int64) for ce in ces])
+    if not len(ch_lv):
+        return {}
+    as_i64 = lambda a: np.asarray(a, dtype=np.int64)  # noqa: E731
+    nchars = [ce.num_chars() for ce in ces]
+    z = np.zeros(0, np.int64)
+    ch_kind = cat([as_i64(ce.ch_kind) if n else z
+                   for ce, n in zip(ces, nchars)])
+    ch_anchor = cat([as_i64(ce.ch_anchor) if n else z
+                     for ce, n in zip(ces, nchars)])
+    ch_orrown = cat([as_i64(ce.ch_orrown) if n else z
+                     for ce, n in zip(ces, nchars)])
+    # entry-local query ids -> one flat query table via per-entry offsets
+    q_lens = [len(ce.q_cursor) for ce in ces]
+    q_off = np.cumsum([0] + q_lens[:-1])
+    flat_q = cat([as_i64(ce.q_cursor) if q else z
+                  for ce, q in zip(ces, q_lens)]) if sum(q_lens) \
+        else np.zeros(1, np.int64)
+    ch_q = cat([np.where(as_i64(ce.ch_q) >= 0, as_i64(ce.ch_q) + off, -1)
+                if n else z
+                for ce, n, off in zip(ces, nchars, q_off)])
+    from ..listmerge.zone_np import _slot_of
+    slots = _slot_of(prep, ch_lv).astype(np.int64)
+    anchor = np.where(ch_anchor >= 0,
+                      _slot_of(prep, np.maximum(ch_anchor, 0)), -1)
+    orr_own = np.where(ch_orrown >= 0,
+                       _slot_of(prep, np.maximum(ch_orrown, 0)), -1)
+    c_of = flat_q[np.clip(ch_q, 0, None)]
+    ol_static, ol_coord = _origin_encoding(ch_kind, slots, anchor, c_of)
+    ag = np.asarray(prep.agent_k)[slots]
+    sq = np.asarray(prep.seq_k)[slots]
+    nb = [len(ce.blk_root_lv) if ce.num_chars() else 0 for ce in ces]
+    root_slots = _slot_of(prep, cat(
+        [as_i64(ce.blk_root_lv) if n else z for ce, n in zip(ces, nb)])) \
+        if sum(nb) else z
+    out = {}
+    c0 = b0 = 0
+    for i, (ce, n, bn) in enumerate(zip(ces, nchars, nb)):
+        if n:
+            sl = slice(c0, c0 + n)
+            out[i] = (slots[sl], ol_static[sl], ol_coord[sl],
+                      orr_own[sl], ag[sl], sq[sl],
+                      root_slots[b0:b0 + bn])
+        c0 += n
+        b0 += bn
+    return out
+
+
 def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
                    max_chars: int = 512, max_dels: int = 16) -> ZoneTape:
     """Flatten a prepared zone (plan + composed entries) into the tape."""
     MB, MC, MD = max_blocks, max_chars, max_dels
     steps: List[dict] = []
+    all_cols = _batched_columns(prep)
 
     def new_step(op, a=0, b=0, snap=0):
         s = dict(op=op, a=a, b=b, snap=snap,
@@ -181,7 +269,8 @@ def pack_zone_tape(prep: ZonePrep, max_blocks: int = 8,
                 return _slot_of(prep, lvs)
 
             entry_steps(ce, slot_fn, prep.agent_k, prep.seq_k,
-                        MB, MC, MD, cur, next_sub)
+                        MB, MC, MD, cur, next_sub,
+                        cols=all_cols.get(act[1]))
 
     return _fill_tape(steps, prep.W, prep.plen,
                       max(1, prep.plan.indexes_used),
@@ -552,9 +641,11 @@ def zone_checkout_device(oplog, from_frontier: Sequence[int] = (),
                          prep: Optional[ZonePrep] = None,
                          tape: Optional[ZoneTape] = None):
     """Full device checkout/merge via the zone kernel. Returns
-    (text, frontier). Every run records its throughput into the engine
-    policy (listmerge/policy.py) — this is how the policy's zone rate
-    bootstraps regardless of who started the run."""
+    (text, frontier). FULL runs (prep and tape computed here) record
+    their throughput into the engine policy (listmerge/policy.py) — this
+    is how the policy's zone rate bootstraps; callers passing precomputed
+    prep/tape are NOT recorded (an execute-only rate would flatter the
+    engine by the dominant compose/pack cost it skipped)."""
     import time as _time
     t0 = _time.perf_counter()
     # Record throughput into the engine policy only for FULL runs (prep

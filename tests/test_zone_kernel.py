@@ -119,3 +119,29 @@ def test_zone_engine_behind_branch_merge(monkeypatch):
     b = ol.checkout_tip()
     assert b.snapshot() == expected
     assert sorted(b.version) == sorted(ol.version)
+
+
+def test_batched_pack_columns_match_per_entry():
+    """pack_zone_tape's whole-corpus batched column pass must produce a
+    byte-identical tape to the per-entry entry_columns path it
+    short-cuts (git-makefile crosses the >=200-entry batching gate)."""
+    import numpy as np
+    from diamond_types_tpu.encoding.decode import load_oplog
+    from diamond_types_tpu.listmerge.zone_np import prepare_zone
+    from diamond_types_tpu.tpu import zone_kernel as zk
+    with open(os.path.join(BENCH_DATA, "git-makefile.dt"), "rb") as f:
+        ol = load_oplog(f.read())
+    prep = prepare_zone(ol, [], list(ol.version))
+    assert len(prep.composed) >= 200   # the gate must actually engage
+    tape = zk.pack_zone_tape(prep)
+    orig = zk._batched_columns
+    zk._batched_columns = lambda p: {}
+    try:
+        tape2 = zk.pack_zone_tape(prep)
+    finally:
+        zk._batched_columns = orig
+    for f in ("op", "arg_a", "arg_b", "snap_flag", "blk_cursor",
+              "blk_prev", "blk_root", "blk_start", "blk_len", "ch_slot",
+              "ch_ol_static", "ch_ol_coord", "ch_orr_own", "ch_blk",
+              "ch_agent", "ch_seq", "del_kind", "del_a", "del_b"):
+        assert np.array_equal(getattr(tape, f), getattr(tape2, f)), f
