@@ -86,17 +86,23 @@ class EnginePolicy:
             return None
         return acc[0] / acc[1]
 
-    def choose(self) -> str:
+    PROBE_MAX_OPS = 20_000
+
+    def choose(self, n_ops_hint=None) -> str:
         """The engine with the best MEASURED rate; the tracker wherever
         evidence is missing (it is the oracle and the measured winner on
-        every host workload to date)."""
+        every host workload to date). `n_ops_hint` bounds exploration:
+        the loser-refresh probe is skipped for merges above
+        PROBE_MAX_OPS, so a probe can never turn one huge merge into a
+        multi-second stall on the slower engine."""
         zr = self.rate(ZONE)
         tr = self.rate(TRACKER)
         if zr is None or tr is None:
             return TRACKER
         self._calls += 1
         best = ZONE if zr > tr else TRACKER
-        if self._calls % self.PROBE_EVERY == 0:
+        if self._calls % self.PROBE_EVERY == 0 and \
+                (n_ops_hint is None or n_ops_hint <= self.PROBE_MAX_OPS):
             return TRACKER if best == ZONE else ZONE   # refresh the loser
         return best
 

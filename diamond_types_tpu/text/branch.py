@@ -162,7 +162,8 @@ class Branch:
         if ctx is not None:
             # fully-default path: measured policy decides (zone is never
             # chosen before it has measurements — see policy.py)
-            if _policy.GLOBAL.choose() == _policy.ZONE:
+            n_hint = _top(merge_frontier) - _top(self.version)
+            if _policy.GLOBAL.choose(n_hint) == _policy.ZONE:
                 try:
                     _zone_merge()
                     return

@@ -25,3 +25,19 @@ REFERENCE_DIR = "/root/reference"
 
 def reference_path(*parts):
     return os.path.join(REFERENCE_DIR, *parts)
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_policy():
+    """Engine-selection measurements must not leak across tests: a zone
+    rate recorded by one test could otherwise flip (or probe-flip) an
+    unrelated later test's Branch.merge onto the zone engine — an
+    ordering-dependent flake and, on big corpora, a CPU-backend stall."""
+    from diamond_types_tpu.listmerge import policy
+    saved = policy.GLOBAL
+    policy.GLOBAL = policy.EnginePolicy()
+    yield
+    policy.GLOBAL = saved

@@ -247,3 +247,17 @@ def test_engine_policy_boundary_differential():
         assert b3.snapshot() == oracle
     finally:
         policy.GLOBAL = saved
+
+
+def test_engine_policy_probe_bounded():
+    """The loser-refresh probe must skip merges above PROBE_MAX_OPS: a
+    probe could otherwise turn one huge merge into a multi-second stall
+    on the slower engine."""
+    from diamond_types_tpu.listmerge import policy
+    p = policy.EnginePolicy()
+    p.record(policy.TRACKER, 100_000, 0.001)
+    p.record(policy.ZONE, 100, 1.0)
+    big = [p.choose(n_ops_hint=10**6) for _ in range(64)]
+    assert big.count(policy.ZONE) == 0          # never probed on big merges
+    small = [p.choose(n_ops_hint=10) for _ in range(64)]
+    assert small.count(policy.ZONE) > 0         # probes still happen
