@@ -50,6 +50,7 @@ class EnginePolicy:
         # engine -> [ops, seconds, last_record_wall_time]
         self._acc: Dict[str, list] = {}
         self._calls = 0
+        self._last_probe = 0
 
     def _decayed(self, engine: str):
         acc = self._acc.get(engine)
@@ -92,17 +93,22 @@ class EnginePolicy:
         """The engine with the best MEASURED rate; the tracker wherever
         evidence is missing (it is the oracle and the measured winner on
         every host workload to date). `n_ops_hint` bounds exploration:
-        the loser-refresh probe is skipped for merges above
-        PROBE_MAX_OPS, so a probe can never turn one huge merge into a
-        multi-second stall on the slower engine."""
+        the loser-refresh probe only fires on merges KNOWN small (a
+        fork merge's frontier-top delta can be tiny or negative while
+        the merge is huge, so a non-positive hint counts as big), and a
+        skipped probe stays due — it fires on the next small merge
+        instead of being consumed, so big-merge-dominated workloads
+        still refresh the loser."""
         zr = self.rate(ZONE)
         tr = self.rate(TRACKER)
         if zr is None or tr is None:
             return TRACKER
         self._calls += 1
         best = ZONE if zr > tr else TRACKER
-        if self._calls % self.PROBE_EVERY == 0 and \
-                (n_ops_hint is None or n_ops_hint <= self.PROBE_MAX_OPS):
+        probe_ok = n_ops_hint is None or \
+            0 < n_ops_hint <= self.PROBE_MAX_OPS
+        if self._calls - self._last_probe >= self.PROBE_EVERY and probe_ok:
+            self._last_probe = self._calls
             return TRACKER if best == ZONE else ZONE   # refresh the loser
         return best
 

@@ -216,10 +216,11 @@ def test_engine_policy_boundary_differential():
         else:
             branches[bi] = (v, c)
 
-    saved = policy.GLOBAL
-    try:
+    # conftest's autouse _fresh_engine_policy fixture isolates GLOBAL;
+    # each scenario below swaps in a fresh policy of its own
+    policy.GLOBAL = policy.EnginePolicy()
+    if True:
         # measured-tracker-wins side of the boundary
-        policy.GLOBAL = policy.EnginePolicy()
         policy.GLOBAL.record(policy.TRACKER, 10_000, 0.001)
         policy.GLOBAL.record(policy.ZONE, 10_000, 1.0)
         b1 = Branch()
@@ -245,8 +246,6 @@ def test_engine_policy_boundary_differential():
         b3.merge(ol, ol.version)
         assert b3.last_merge_engine == policy.TRACKER
         assert b3.snapshot() == oracle
-    finally:
-        policy.GLOBAL = saved
 
 
 def test_engine_policy_probe_bounded():
@@ -259,5 +258,10 @@ def test_engine_policy_probe_bounded():
     p.record(policy.ZONE, 100, 1.0)
     big = [p.choose(n_ops_hint=10**6) for _ in range(64)]
     assert big.count(policy.ZONE) == 0          # never probed on big merges
+    forks = [p.choose(n_ops_hint=-1) for _ in range(64)]
+    assert forks.count(policy.ZONE) == 0        # fork proxy counts as big
+    # a probe skipped on big merges stays DUE: the very next small merge
+    # fires it (big-merge-dominated workloads still refresh the loser)
+    assert p.choose(n_ops_hint=10) == policy.ZONE
     small = [p.choose(n_ops_hint=10) for _ in range(64)]
-    assert small.count(policy.ZONE) > 0         # probes still happen
+    assert small.count(policy.ZONE) > 0         # probes keep happening
