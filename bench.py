@@ -202,12 +202,40 @@ def bench_call(fn, fetch, reps=5):
 """
 
 _PROBE_SNIPPET = _PRELUDE + """
-print("RESULT 1")
+# Liveness verdict FIRST — the RTT measurement below is advisory and
+# must never turn a live-but-slow tunnel into "probe failed" (which
+# would skip every device bench).
+print("RESULT 1", flush=True)
+
+# Tunnel RTT floor: time a trivial 1-element op end to end (dispatch +
+# tunnel round trip + transfer; effectively zero kernel time). This is
+# the intercept of every device bench's latency — reported so device
+# numbers decompose into "tunnel floor" vs "kernel time" (VERDICT r3
+# next-step #1: prove which one binds). Guarded by its own deadline: if
+# the tunnel stalls mid-measurement, exit cleanly with the liveness
+# verdict already on stdout.
+_rtt_done = threading.Event()
+
+def _rtt_guard():
+    if not _rtt_done.wait(20):
+        os._exit(0)
+
+threading.Thread(target=_rtt_guard, daemon=True).start()
+try:
+    x = jnp.arange(4)
+    f = jax.jit(lambda v: v + 1)
+    np.asarray(f(x))   # compile
+    rtt = bench_call(lambda: f(x), lambda r: r, reps=3)
+    print("RTT_MS", rtt * 1e3, flush=True)
+except Exception:
+    pass
+_rtt_done.set()
 """
 
 
 def device_probe(timeout: int = LIVENESS_S + 30):
-    """Cheap tunnel/backend liveness gate run before any device bench."""
+    """Cheap tunnel/backend liveness gate run before any device bench.
+    Also measures the tunnel's RTT floor (returned as rtt_ms)."""
     code = _PROBE_SNIPPET.format(
         repo=os.path.dirname(os.path.abspath(__file__)), liveness=LIVENESS_S)
     return _run_device_bench_retry(code, timeout)
@@ -580,6 +608,9 @@ def _run_device_phase(full: dict) -> dict:
             out[f"{k}_error"] = msg
         return out
     out["device_platform"] = probe.get("platform", "?")
+    if probe.get("rtt_ms") is not None:
+        # every device bench's per-call latency includes this floor
+        out["tunnel_rtt_ms"] = round(float(probe["rtt_ms"]), 2)
 
     consecutive_wedges = 0
 
