@@ -590,12 +590,32 @@ def _short_err(r: dict) -> str:
     return s[:120]
 
 
+def _flush_partial(full: dict, out: dict) -> None:
+    """Persist per-bench progress (VERDICT r4 #2: a re-wedge between
+    benches must not erase an earlier catch). Atomic rename so a reader
+    never sees a torn file; silent no-op without DT_DEVICE_PARTIAL_PATH."""
+    path = os.environ.get("DT_DEVICE_PARTIAL_PATH")
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"summary": out, "full": full,
+                       "flushed_at": time.time()}, f, indent=1, default=str)
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError):
+        # best-effort: a serialization quirk (default=str covers values,
+        # not dict keys) must never abort the device phase it documents
+        pass
+
+
 def _run_device_phase(full: dict) -> dict:
     """All device benches, probe-gated, wedge-bounded. Returns a dict of
     summary-line entries (scalars + short error strings)."""
     out = {}
     probe = device_probe()
     full["device_probe"] = probe
+    _flush_partial(full, out)
     if not probe.get("ok"):
         attempts = "twice" if probe.get("retried") else "once (no retry: " \
             "failure signature is not a wedge)"
@@ -606,6 +626,7 @@ def _run_device_phase(full: dict) -> dict:
                   "tpu_merge_git_makefile_pallas",
                   "tpu_session_friendsforever"):
             out[f"{k}_error"] = msg
+        _flush_partial(full, out)
         return out
     out["device_platform"] = probe.get("platform", "?")
     if probe.get("rtt_ms") is not None:
@@ -616,6 +637,10 @@ def _run_device_phase(full: dict) -> dict:
 
     def guarded(name, fn):
         nonlocal consecutive_wedges
+        # entry flush picks up the PREVIOUS bench's summary entries (the
+        # caller adds them to `out` after guarded returns); the phase-end
+        # flush covers the last bench
+        _flush_partial(full, out)
         if consecutive_wedges >= 2:
             full[name] = {"ok": False, "why": "skipped: tunnel wedged "
                           "(2 consecutive device benches failed)"}
@@ -711,6 +736,7 @@ def _run_device_phase(full: dict) -> dict:
         out["fanin_10k_propagation_ms"] = round(r["value"], 3)
     else:
         out["fanin_10k_error"] = _short_err(r)
+    _flush_partial(full, out)
     return out
 
 

@@ -432,11 +432,6 @@ def compose_entry(oplog, span: Tuple[int, int]) -> ComposedEntry:
     return comp.finish()
 
 
-def _native_ctx_or_none(oplog):
-    from ..native import native_ctx_or_none
-    return native_ctx_or_none(oplog)
-
-
 def _native_composed(oplog, spans) -> Optional[List[ComposedEntry]]:
     """Run the C++ composer (native/dt_core.cpp Composer — same piece-
     table semantics, ~20x faster); None when unavailable/unsupported."""

@@ -35,6 +35,7 @@ Selection properties:
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict
 
@@ -45,12 +46,24 @@ ZONE = "zone"
 class EnginePolicy:
     PROBE_EVERY = 16
     HALF_LIFE_S = 300.0
+    # After a failure-demotion (forget) the engine has no rate, and zone
+    # rates are only recorded by zone runs — without a re-probe nothing
+    # in-process could ever measure it again, so a transient accelerator
+    # blip would disable the faster engine for the process lifetime
+    # (ADVICE r4). One probe-sized retry is allowed per cooldown window.
+    DEMOTION_COOLDOWN_S = 60.0
 
     def __init__(self) -> None:
         # engine -> [ops, seconds, last_record_wall_time]
         self._acc: Dict[str, list] = {}
         self._calls = 0
         self._last_probe = 0
+        self._demoted_at: Dict[str, float] = {}
+        # record()/choose() run concurrently in multi-threaded embedders
+        # (tools/server.py merges from HTTP handler threads); unguarded,
+        # _decayed's in-place rescale races with record() and can corrupt
+        # rates or double-probe (ADVICE r4).
+        self._lock = threading.Lock()
 
     def _decayed(self, engine: str):
         acc = self._acc.get(engine)
@@ -70,22 +83,37 @@ class EnginePolicy:
             # under-counts) would add pure denominator and corrupt the
             # rate; skip them
             return
-        acc = self._decayed(engine)
-        if acc is None:
-            acc = self._acc[engine] = [0.0, 0.0, time.monotonic()]
-        acc[0] += n_ops
-        acc[1] += seconds
+        with self._lock:
+            acc = self._decayed(engine)
+            if acc is None:
+                acc = self._acc[engine] = [0.0, 0.0, time.monotonic()]
+            acc[0] += n_ops
+            acc[1] += seconds
+            # a successful measurement clears any standing demotion
+            self._demoted_at.pop(engine, None)
 
     def forget(self, engine: str) -> None:
         """Drop an engine's measurements (e.g. it just failed): the
-        policy stops choosing it until it is measured again."""
-        self._acc.pop(engine, None)
+        policy stops choosing it until it is measured again — except the
+        ZONE engine, which gets one probe-eligible re-try per
+        DEMOTION_COOLDOWN_S (see choose(); the tracker is the default
+        and never needs recovery, so cooldown bookkeeping is zone-only)."""
+        with self._lock:
+            self._acc.pop(engine, None)
+            if engine == ZONE:
+                self._demoted_at[engine] = time.monotonic()
 
-    def rate(self, engine: str):
+    def _rate_locked(self, engine: str):
+        """Decayed ops/sec for `engine`, or None unmeasured. Caller
+        holds self._lock (the lock is not reentrant)."""
         acc = self._decayed(engine)
         if acc is None or acc[1] <= 0:
             return None
         return acc[0] / acc[1]
+
+    def rate(self, engine: str):
+        with self._lock:
+            return self._rate_locked(engine)
 
     PROBE_MAX_OPS = 20_000
 
@@ -99,24 +127,41 @@ class EnginePolicy:
         skipped probe stays due — it fires on the next small merge
         instead of being consumed, so big-merge-dominated workloads
         still refresh the loser."""
-        zr = self.rate(ZONE)
-        tr = self.rate(TRACKER)
-        if zr is None or tr is None:
-            return TRACKER
-        self._calls += 1
-        best = ZONE if zr > tr else TRACKER
-        probe_ok = n_ops_hint is None or \
+        # a missing hint counts as probe-eligible (same rule as the
+        # loser-refresh probe below): hint-less embedder calls must not
+        # be the one path where a demoted engine can never recover
+        probe_eligible = n_ops_hint is None or \
             0 < n_ops_hint <= self.PROBE_MAX_OPS
-        if self._calls - self._last_probe >= self.PROBE_EVERY and probe_ok:
-            self._last_probe = self._calls
-            return TRACKER if best == ZONE else ZONE   # refresh the loser
-        return best
+        with self._lock:
+            zr = self._rate_locked(ZONE)
+            tr = self._rate_locked(TRACKER)
+            if zr is None and tr is not None and probe_eligible:
+                # demotion-cooldown re-probe: a forgotten (failed) zone
+                # engine gets one probe-sized retry per cooldown window,
+                # so a transient blip can't disable it for the process
+                # lifetime. Re-arm the window now; a second failure just
+                # waits out the next one, a success clears it (record()).
+                demoted = self._demoted_at.get(ZONE)
+                if demoted is not None and \
+                        time.monotonic() - demoted >= self.DEMOTION_COOLDOWN_S:
+                    self._demoted_at[ZONE] = time.monotonic()
+                    return ZONE
+            if zr is None or tr is None:
+                return TRACKER
+            self._calls += 1
+            best = ZONE if zr > tr else TRACKER
+            if self._calls - self._last_probe >= self.PROBE_EVERY \
+                    and probe_eligible:
+                self._last_probe = self._calls
+                return TRACKER if best == ZONE else ZONE  # refresh loser
+            return best
 
     def snapshot(self) -> dict:
         """Observability (reported in bench_report_full.json): measured
         ops/sec per engine."""
-        return {e: round(a[0] / a[1])
-                for e, a in self._acc.items() if a[1] > 0}
+        with self._lock:
+            return {e: round(a[0] / a[1])
+                    for e, a in self._acc.items() if a[1] > 0}
 
 
 GLOBAL = EnginePolicy()

@@ -24,9 +24,14 @@ class Branch:
         # collisions reported by the last merge() — genuinely concurrent
         # inserts at the same gap (reference: has_conflicts_when_merging,
         # src/list/merge.rs:51). None = the selected engine doesn't report
-        # (plan2/device tiers); 0 = merged cleanly.
+        # (zone/plan2/device tiers); 0 = merged cleanly. A fully-default
+        # merge() can return None once the measured policy has zone
+        # measurements: check last_merge_engine to detect which engine
+        # ran, and use OpLog.has_conflicts_when_merging (before merging)
+        # when a collision count is required regardless of engine.
         self.last_merge_collisions: Optional[int] = None
-        # which engine the policy picked for the last merge()
+        # which engine the policy picked for the last merge() — the
+        # supported way to interpret last_merge_collisions above
         self.last_merge_engine: Optional[str] = None
 
     def __len__(self) -> int:
@@ -161,7 +166,9 @@ class Branch:
         ctx = native_ctx_or_none(oplog)
         if ctx is not None:
             # fully-default path: measured policy decides (zone is never
-            # chosen before it has measurements — see policy.py)
+            # chosen before it has measurements, with one exception: a
+            # cooldown re-probe after a failure-demotion, which implies
+            # zone already ran in this process — see policy.py)
             n_hint = _top(merge_frontier) - _top(self.version)
             if _policy.GLOBAL.choose(n_hint) == _policy.ZONE:
                 try:

@@ -81,6 +81,11 @@ class DocStore:
         self.save_interval = save_interval
         self.docs: Dict[str, OpLog] = {}
         self.dirty: Dict[str, float] = {}
+        # doc -> consecutive flush failures (encode OR disk write);
+        # drives exponential backoff so a persistently-unpersistable doc
+        # can't spam stderr and burn O(doc) encode work on every flush
+        # pass forever (ADVICE r4)
+        self.flush_failures: Dict[str, int] = {}
         self.lock = threading.Lock()
         self.io_lock = threading.Lock()   # serializes flush passes
         # Long-poll wakeups (one condition per doc; notified on new ops).
@@ -144,7 +149,12 @@ class DocStore:
 
     def mark_dirty(self, doc_id: str) -> None:
         with self.lock:
-            self.dirty.setdefault(doc_id, time.monotonic())
+            now = time.monotonic()
+            t = self.dirty.setdefault(doc_id, now)
+            if t > now:
+                # the doc was in encode-failure backoff; a new edit
+                # changed its content, so a prompt retry is worth it
+                self.dirty[doc_id] = now
 
     def flush(self, force: bool = False) -> None:
         if self.data_dir is None:
@@ -174,19 +184,65 @@ class DocStore:
                         # One unencodable doc (e.g. poisoned before input
                         # validation existed) must not abort the pass and
                         # silently drop OTHER docs' dirty flags; re-mark
-                        # it so the failure stays visible to retries, and
-                        # leave a diagnostic trail for operators.
-                        import traceback
-                        print(f"flush: encode failed for doc {d!r}:",
-                              file=sys.stderr)
-                        traceback.print_exc()
-                        self.dirty[d] = now
+                        # it so the failure stays visible to retries —
+                        # but with exponential backoff (cap 10 min) and
+                        # the full traceback only on the FIRST failure,
+                        # so a persistently-broken doc degrades to one
+                        # retry per backoff window instead of stderr spam
+                        # on every pass.
+                        if self._note_flush_failure(d, now, "encode") == 1:
+                            import traceback
+                            traceback.print_exc()
+            # Disk writes get the SAME per-doc failure handling: an
+            # ENOSPC/EIO on one doc's tmp file must not abort the loop
+            # and silently drop the remaining docs' (already-cleared)
+            # dirty flags — an idle doc's edits would otherwise never be
+            # persisted again.
             for doc_id, blob in blobs:
                 path = self._path(doc_id)
                 tmp = path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, path)  # atomic
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(blob)
+                    os.replace(tmp, path)  # atomic
+                    # persistence truly completed: only now is the
+                    # consecutive-failure streak over (clearing on encode
+                    # success would reset a write-failure backoff every
+                    # pass and bring back the per-pass log spam)
+                    with self.lock:
+                        self.flush_failures.pop(doc_id, None)
+                except OSError:
+                    with self.lock:
+                        self._note_flush_failure(doc_id, now, "write")
+
+    def _note_flush_failure(self, d: str, now: float, stage: str) -> int:
+        """Record one flush failure for doc `d` (caller holds self.lock
+        and is inside the `except` block): bump the consecutive-failure
+        counter, re-mark the doc dirty with exponential backoff, and log
+        on the first failure / each doubling. Returns the new count."""
+        fails = self.flush_failures.get(d, 0) + 1
+        self.flush_failures[d] = fails
+        e = sys.exc_info()[1]
+        if fails == 1:
+            print(f"flush: {stage} failed for doc {d!r}: {e!r}",
+                  file=sys.stderr)
+        elif (fails & (fails - 1)) == 0:  # 2, 4, 8, ...
+            # keep the current exception text in the trail: the failure
+            # REASON can change between passes (content changes cut the
+            # backoff) and the first log line may describe a stale cause
+            print(f"flush: {stage} still failing for doc {d!r} "
+                  f"({fails} consecutive failures, backing off; "
+                  f"latest: {e!r})", file=sys.stderr)
+        # exponent bounded: 2**fails would overflow float->int conversion
+        # near fails=1025 and kill the flusher thread for the whole server
+        backoff = min(max(self.save_interval, 1.0)
+                      * (2 ** min(fails, 10)), 600.0)
+        if self.dirty.get(d) is None:
+            # the write path runs outside self.lock: a handler thread may
+            # have mark_dirty'd the doc mid-write (new edit -> prompt
+            # retry); that timestamp must win over the backoff re-mark
+            self.dirty[d] = now + backoff - self.save_interval
+        return fails
 
 
 def _utf8_clean(s: str) -> bool:

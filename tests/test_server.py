@@ -258,6 +258,98 @@ def test_flush_races_concurrent_edits(tmp_path):
         httpd.shutdown()
 
 
+def test_flush_encode_failure_backoff(tmp_path, capsys):
+    """A doc whose encode persistently fails must back off exponentially
+    instead of spamming a full traceback + O(doc) encode on every pass
+    (ADVICE r4); a new edit cuts the backoff, a success clears it."""
+    from diamond_types_tpu.tools.server import DocStore
+
+    store = DocStore(data_dir=str(tmp_path), save_interval=0.0)
+
+    class Bomb:
+        """Stands in for an OpLog poisoned before input validation."""
+        armed = True
+
+    real_encode = None
+    import diamond_types_tpu.tools.server as srv
+    real_encode = srv.encode_oplog
+
+    def fake_encode(ol, *a, **k):
+        if isinstance(ol, Bomb) and ol.armed:
+            raise ValueError("poisoned")
+        if isinstance(ol, Bomb):
+            return b"ok"
+        return real_encode(ol, *a, **k)
+
+    srv.encode_oplog = fake_encode
+    try:
+        bomb = Bomb()
+        store.docs["bad"] = bomb
+        store.mark_dirty("bad")
+        for _ in range(6):
+            store.flush()
+        # backoff engaged: the doc is dirty with a FUTURE due time and
+        # far fewer than 6 tracebacks were printed
+        assert store.flush_failures["bad"] >= 1
+        assert store.dirty["bad"] > __import__("time").monotonic()
+        err = capsys.readouterr().err
+        assert err.count("Traceback") == 1      # first failure only
+        fails_before = store.flush_failures["bad"]
+        # a new edit cuts the standing backoff -> prompt retry
+        store.mark_dirty("bad")
+        store.flush()
+        assert store.flush_failures["bad"] == fails_before + 1
+        # and a success clears the failure state entirely
+        bomb.armed = False
+        store.mark_dirty("bad")
+        store.flush()
+        assert "bad" not in store.flush_failures
+        assert (tmp_path / "bad.dt").read_bytes() == b"ok"
+    finally:
+        srv.encode_oplog = real_encode
+
+
+def test_flush_write_failure_remarks_dirty(tmp_path, capsys):
+    """A disk-write failure (ENOSPC/EIO) on one doc must not abort the
+    write loop or silently drop the already-cleared dirty flags — the
+    failing doc re-enters the backoff cycle and later docs still write."""
+    import diamond_types_tpu.tools.server as srv
+    from diamond_types_tpu.tools.server import DocStore
+    from diamond_types_tpu.text.oplog import OpLog
+
+    store = DocStore(data_dir=str(tmp_path), save_interval=0.0)
+    for name, text in (("aa", "first"), ("bb", "second")):
+        ol = OpLog()
+        ag = ol.get_or_create_agent_id("u")
+        ol.add_insert_at(ag, [], 0, text)
+        store.docs[name] = ol
+        store.mark_dirty(name)
+
+    real_replace = srv.os.replace
+    def flaky_replace(src, dst):
+        if dst.endswith("aa.dt"):
+            raise OSError(28, "No space left on device")
+        return real_replace(src, dst)
+
+    srv.os.replace = flaky_replace
+    try:
+        store.flush()
+        # bb still persisted despite aa's write failure; aa is re-dirty
+        # with backoff and counted
+        assert (tmp_path / "bb.dt").exists()
+        assert not (tmp_path / "aa.dt").exists()
+        assert store.flush_failures["aa"] >= 1
+        assert "aa" in store.dirty and "bb" not in store.dirty
+        assert "write failed" in capsys.readouterr().err
+    finally:
+        srv.os.replace = real_replace
+    # recovery: disk "freed", edit cuts the backoff, write succeeds
+    store.mark_dirty("aa")
+    store.flush()
+    assert (tmp_path / "aa.dt").exists()
+    assert "aa" not in store.flush_failures
+
+
 def test_changes_long_poll_streams_edits(tmp_path):
     """A waiting /changes request returns as soon as another client edits
     (braid-subscription equivalent of the reference wiki streaming)."""

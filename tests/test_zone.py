@@ -265,3 +265,34 @@ def test_engine_policy_probe_bounded():
     assert p.choose(n_ops_hint=10) == policy.ZONE
     small = [p.choose(n_ops_hint=10) for _ in range(64)]
     assert small.count(policy.ZONE) > 0         # probes keep happening
+
+
+def test_engine_policy_demotion_cooldown_reprobe(monkeypatch):
+    """A failure-demotion (forget) must not disable the zone engine for
+    the process lifetime (ADVICE r4): after DEMOTION_COOLDOWN_S one
+    probe-eligible merge re-tries it, a success clears the demotion, and
+    a renewed failure just waits out the next window. Clock is faked so
+    the test is deterministic under CI load."""
+    from diamond_types_tpu.listmerge import policy
+    now = [1000.0]
+    monkeypatch.setattr(policy.time, "monotonic", lambda: now[0])
+    p = policy.EnginePolicy()   # real DEMOTION_COOLDOWN_S (60 s)
+    p.record(policy.TRACKER, 10_000, 0.01)
+    p.record(policy.ZONE, 100_000, 0.01)
+    assert p.choose(100) == policy.ZONE
+    p.forget(policy.ZONE)
+    assert p.choose(100) == policy.TRACKER       # inside the cooldown
+    now[0] += p.DEMOTION_COOLDOWN_S + 1
+    assert p.choose(10**7) == policy.TRACKER     # big merge: never a probe
+    assert p.choose(100) == policy.ZONE          # cooldown re-probe fires
+    assert p.choose(100) == policy.TRACKER       # window re-armed
+    p.record(policy.ZONE, 100_000, 0.01)         # the probe succeeded
+    assert p.choose(100) == policy.ZONE          # back in rotation
+    p.forget(policy.ZONE)
+    now[0] += p.DEMOTION_COOLDOWN_S + 1
+    # hint-less embedder calls are probe-eligible too: they must not be
+    # the one path where a demoted engine can never recover
+    assert p.choose() == policy.ZONE
+    # second consecutive failure: nothing until the NEXT window
+    p.forget(policy.ZONE)
+    assert p.choose(100) == policy.TRACKER
