@@ -212,16 +212,9 @@ class NativeContext:
         fwd = np.asarray([1 if r.fwd else 0 for r in runs], dtype=np.uint8)
         st = np.asarray([r.start for r in runs], dtype=np.int64)
         en = np.asarray([r.end for r in runs], dtype=np.int64)
-        cp = np.asarray(
-            [r.content_pos[0] if r.content_pos is not None else -1
-             for r in runs], dtype=np.int64)
+        cp, arena, arena_chars = content_columns(ol)
         lib.dt_load_ops(self._ptr, len(runs), lv, kind, fwd, st, en, cp)
-        from ..text.op import INS
-        arena_str = ol.ops._arenas[INS].get((0, ol.ops.arena_len(INS)))
-        arena = np.frombuffer(arena_str.encode("utf-32-le"), dtype=np.int32)
-        if arena.size == 0:
-            arena = np.zeros(1, dtype=np.int32)
-        lib.dt_load_ins_arena(self._ptr, len(arena_str),
+        lib.dt_load_ins_arena(self._ptr, arena_chars,
                               np.ascontiguousarray(arena))
         self._built_len = len(ol)
 
@@ -598,6 +591,24 @@ def get_native_ctx(oplog) -> "NativeContext":
         ctx = NativeContext(oplog)
         oplog._native_ctx = ctx
     return ctx
+
+
+def content_columns(oplog):
+    """(cp, arena) columns in the exact layout dt_load_ops /
+    dt_load_ins_arena expect: per-run insert-arena offset (-1 = no
+    content) and the whole INS arena as utf-32 code points. Shared by
+    NativeContext.sync and tools/dump_columns so the native loaders'
+    arena invariants live in one place."""
+    from ..text.op import INS
+    runs = oplog.ops.runs
+    cp = np.asarray(
+        [r.content_pos[0] if r.content_pos is not None else -1
+         for r in runs], dtype=np.int64)
+    arena_str = oplog.ops._arenas[INS].get((0, oplog.ops.arena_len(INS)))
+    arena = np.frombuffer(arena_str.encode("utf-32-le"), dtype=np.int32)
+    if arena.size == 0:
+        arena = np.zeros(1, dtype=np.int32)
+    return cp, arena, len(arena_str)
 
 
 def merge_native(oplog, init: str, from_frontier, merge_frontier):

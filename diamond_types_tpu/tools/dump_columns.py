@@ -11,6 +11,10 @@ import sys
 
 import numpy as np
 
+# 'DTCOL' + format version; bump when columns change (bench_main.cpp
+# checks the same constant)
+DUMP_MAGIC = 0x4454434F4C_02
+
 
 def dump(oplog, path: str) -> None:
     g = oplog.cg.graph
@@ -20,6 +24,10 @@ def dump(oplog, path: str) -> None:
     gr = oplog.cg.agent_assignment.global_runs
     runs = oplog.ops.runs
     with open(path, "wb") as f:
+        # magic+version header (checked by bench_main.cpp): a stale dump
+        # fed to a newer harness must fail with an actionable message,
+        # not a mid-file EOF
+        f.write(struct.pack("<q", DUMP_MAGIC))
         names = oplog.cg.agent_assignment.agent_names
         f.write(struct.pack("<q", len(names)))
         for name in names:
@@ -46,6 +54,13 @@ def dump(oplog, path: str) -> None:
         vec([1 if r.fwd else 0 for r in runs], np.uint8)
         vec([r.start for r in runs], np.int64)
         vec([r.end for r in runs], np.int64)
+        # content columns (same layout NativeContext.sync feeds
+        # dt_load_ops/dt_load_ins_arena — one shared builder) so the
+        # harness can also drive dt_merge_into_doc's assembly path
+        from ..native.core import content_columns
+        cp, arena, _ = content_columns(oplog)
+        vec(cp, np.int64)
+        vec(arena, np.int32)
         vec(sorted(oplog.cg.version), np.int64)
 
 

@@ -15,6 +15,9 @@ void dt_load_graph(void*, i64, const i64*, const i64*, const i64*, const i64*, c
 void dt_load_agent_runs(void*, i64, const i64*, const i64*, const i64*, const i64*);
 void dt_load_ops(void*, i64, const i64*, const u8*, const u8*, const i64*, const i64*, const i64*);
 i64 dt_transform(void*, const i64*, i64, const i64*, i64);
+i64 dt_merge_into_doc(void*, const int32_t*, i64, const i64*, i64,
+                      const i64*, i64);
+void dt_load_ins_arena(void*, i64, const int32_t*);
 void dt_prof_dump();
 }
 
@@ -32,6 +35,16 @@ int main(int argc, char** argv) {
   int iters = argc > 2 ? atoi(argv[2]) : 10;
   FILE* f = fopen(argv[1], "rb");
   if (!f) { perror("open"); return 1; }
+  // 'DTCOL' + version; must match tools/dump_columns.py DUMP_MAGIC
+  const i64 DUMP_MAGIC = 0x4454434F4C02ll;
+  i64 magic;
+  if (fread(&magic, 8, 1, f) != 1 || magic != DUMP_MAGIC) {
+    fprintf(stderr,
+            "stale or foreign dump (magic %llx, want %llx): regenerate "
+            "with python -m diamond_types_tpu.tools.dump_columns\n",
+            (unsigned long long)magic, (unsigned long long)DUMP_MAGIC);
+    return 1;
+  }
   i64 n_agents;
   fread(&n_agents, 8, 1, f);
   void* ctx = dt_ctx_new();
@@ -58,15 +71,25 @@ int main(int argc, char** argv) {
   auto ofwd = read_vec<u8>(f);
   auto ost = read_vec<i64>(f);
   auto oen = read_vec<i64>(f);
+  auto ocp = read_vec<i64>(f);
+  auto arena = read_vec<int32_t>(f);
   dt_load_ops(ctx, olv.size(), olv.data(), okind.data(), ofwd.data(),
-              ost.data(), oen.data(), ost.data() /* cp unused here */);
+              ost.data(), oen.data(), ocp.data());
+  dt_load_ins_arena(ctx, arena.size(), arena.data());
   auto ver = read_vec<i64>(f);
   fclose(f);
   i64 total = 0;
   double best = 1e18;
+  // BENCH_DOC=1: time the full merge (transform + doc assembly) the
+  // Python checkout path pays, not just the transform
+  bool full_doc = getenv("BENCH_DOC") != nullptr;
   for (int it = 0; it < iters; it++) {
     auto t0 = std::chrono::steady_clock::now();
-    total += dt_transform(ctx, nullptr, 0, ver.data(), ver.size());
+    if (full_doc)
+      total += dt_merge_into_doc(ctx, nullptr, 0, nullptr, 0, ver.data(),
+                                 ver.size());
+    else
+      total += dt_transform(ctx, nullptr, 0, ver.data(), ver.size());
     double dt = std::chrono::duration<double>(
         std::chrono::steady_clock::now() - t0).count();
     if (dt < best) best = dt;

@@ -76,6 +76,11 @@ struct Graph {
   inline size_t pn(size_t i) const { return pindptr[i + 1] - pindptr[i]; }
   inline const i64* pb(size_t i) const { return pflat.data() + pindptr[i]; }
 
+  // The graph's version frontier (ascending): every entry-final LV that
+  // no other entry references as a parent. Used by transform's trivial
+  // checkout fast path (from=[] merging the full graph).
+  std::vector<i64> heads;
+
   void build_idx() {
     idx_of.assign(starts.empty() ? 0 : (size_t)ends.back(), 0);
     for (size_t i = 0; i < starts.size(); i++)
@@ -87,6 +92,13 @@ struct Graph {
       dent[i].np = (int32_t)n;
       for (size_t k = 0; k < n && k < 2; k++) dent[i].p[k] = pb(i)[k];
     }
+    heads.clear();
+    std::vector<i64> ps(pflat);
+    std::sort(ps.begin(), ps.end());
+    for (i64 e : ends)
+      if (!std::binary_search(ps.begin(), ps.end(), e - 1))
+        heads.push_back(e - 1);
+    std::sort(heads.begin(), heads.end());
   }
 
   inline size_t find_idx(i64 v) const { return idx_of[v]; }
@@ -1590,45 +1602,54 @@ struct Zone {
           spans.push_back({*ib++, 1});
       }
     }
-    // 2. chop at graph entry boundaries -> proto piece spans
-    struct Proto { Span s; u8 phase; bool entry_head; };
+    // 2. chop at graph entry boundaries -> proto piece spans. The graph
+    //    entry index only moves forward across the ascending spans, so
+    //    one binary search per span (not per entry) suffices.
+    struct Proto { Span s; u8 phase; bool entry_head; uint32_t gi; };
     std::vector<Proto> protos;
+    protos.reserve(spans.size() * 2);
     for (const SP& sp : spans) {
       i64 start = sp.s.start, end = sp.s.end;
       size_t i = g.find_idx(start);
       while (start < end) {
         i64 t_end = std::min(g.ends[i], end);
-        protos.push_back({{start, t_end}, sp.phase, start == g.starts[i]});
+        protos.push_back({{start, t_end}, sp.phase, start == g.starts[i],
+                          (uint32_t)i});
         start = t_end;
         i++;
       }
     }
     // 3. collect split points: every parent reference p with p+1 strictly
-    //    inside a piece forces a boundary at p+1
+    //    inside a piece forces a boundary at p+1. Gather every candidate
+    //    first, then keep the strictly-inside ones with one merge-join
+    //    over the sorted protos (p+1 strictly inside a proto implies p is
+    //    inside the same proto, so the two containment formulations are
+    //    equivalent) — no per-parent binary search.
     std::vector<i64> cuts;
-    auto find_proto = [&](i64 v) -> int {
-      int lo = 0, hi = (int)protos.size();
-      while (lo < hi) {
-        int mid = (lo + hi) / 2;
-        if (v < protos[mid].s.start) hi = mid;
-        else if (v >= protos[mid].s.end) lo = mid + 1;
-        else return mid;
-      }
-      return -1;
-    };
     for (const Proto& pr : protos) {
       if (!pr.entry_head) continue;  // mid-entry pieces: single parent start-1
-      size_t gi = g.find_idx(pr.s.start);
-      for (size_t k = 0; k < g.pn(gi); k++) {
-        i64 p = g.pb(gi)[k];
-        int pi = find_proto(p);
-        if (pi >= 0 && p + 1 > protos[pi].s.start && p + 1 < protos[pi].s.end)
-          cuts.push_back(p + 1);
-      }
+      for (size_t k = 0; k < g.pn(pr.gi); k++)
+        cuts.push_back(g.pb(pr.gi)[k] + 1);
     }
     std::sort(cuts.begin(), cuts.end());
     cuts.erase(std::unique(cuts.begin(), cuts.end()), cuts.end());
-    // 4. final pieces
+    {
+      size_t keep = 0, pi = 0;
+      for (i64 c : cuts) {
+        while (pi < protos.size() && protos[pi].s.end <= c) pi++;
+        if (pi < protos.size() && c > protos[pi].s.start &&
+            c < protos[pi].s.end)
+          cuts[keep++] = c;
+      }
+      cuts.resize(keep);
+    }
+    // 4. final pieces (pgi carries each piece's graph entry from step 2,
+    //    phead whether it starts that entry — saves re-searching in 5)
+    pieces.reserve(protos.size() + cuts.size());
+    std::vector<uint32_t> pgi;
+    std::vector<u8> phead;
+    pgi.reserve(protos.size() + cuts.size());
+    phead.reserve(protos.size() + cuts.size());
     size_t ci = 0;
     for (const Proto& pr : protos) {
       while (ci < cuts.size() && cuts[ci] <= pr.s.start) ci++;
@@ -1644,41 +1665,50 @@ struct Zone {
         p.np_global = head ? 2 : 1;  // refined below for true heads
         p.pstart = 0; p.np = 0;
         pieces.push_back(p);
+        pgi.push_back(pr.gi);
+        phead.push_back(head ? 1 : 0);
         start = end;
         head = false;
       }
     }
-    // 5. local parents
-    auto find_piece = [&](i64 v) -> int {
-      int lo = 0, hi = (int)pieces.size();
-      while (lo < hi) {
-        int mid = (lo + hi) / 2;
-        if (v < pieces[mid].span.start) hi = mid;
-        else if (v >= pieces[mid].span.end) lo = mid + 1;
-        else return mid;
+    // 5. local parents. Every in-zone parent reference lands on a piece's
+    //    last LV (that is what the cuts guarantee), so a linear-probe
+    //    hash of span.end-1 -> piece idx answers each lookup O(1) — the
+    //    old per-parent binary search was the constructor's hot spot.
+    size_t hbits = 3;
+    while ((1u << hbits) < pieces.size() * 2) hbits++;
+    const size_t hmask = (1u << hbits) - 1;
+    std::vector<i64> hkey(hmask + 1, -2);   // -2: empty (LVs are >= 0)
+    std::vector<int32_t> hval(hmask + 1);
+    auto hput = [&](i64 key, int32_t val) {
+      size_t h = ((uint64_t)key * 0x9E3779B97F4A7C15ull) >> (64 - hbits);
+      while (hkey[h] != -2) h = (h + 1) & hmask;
+      hkey[h] = key; hval[h] = val;
+    };
+    auto hget = [&](i64 key) -> int32_t {
+      size_t h = ((uint64_t)key * 0x9E3779B97F4A7C15ull) >> (64 - hbits);
+      while (hkey[h] != -2) {
+        if (hkey[h] == key) return hval[h];
+        h = (h + 1) & hmask;
       }
       return -1;
     };
+    for (size_t i = 0; i < pieces.size(); i++)
+      hput(pieces[i].span.end - 1, (int32_t)i);
     for (size_t i = 0; i < pieces.size(); i++) {
       Piece& p = pieces[i];
-      size_t gi = g.find_idx(p.span.start);
+      size_t gi = pgi[i];
       p.pstart = (int32_t)lpar.size();
-      if (p.span.start == g.starts[gi]) {
+      if (phead[i]) {
         p.np_global = (u8)std::min<size_t>(g.pn(gi), 255);
         for (size_t k = 0; k < g.pn(gi); k++) {
-          int pi = find_piece(g.pb(gi)[k]);
-          if (pi >= 0) {
-            assert(g.pb(gi)[k] == pieces[pi].span.end - 1);
-            lpar.push_back(pi);
-          }
+          int32_t pi = hget(g.pb(gi)[k]);
+          if (pi >= 0) lpar.push_back(pi);
         }
       } else {
         p.np_global = 1;
-        int pi = find_piece(p.span.start - 1);
-        if (pi >= 0) {
-          assert((i64)pi == (i64)i - 1 || pieces[pi].span.end == p.span.start);
-          lpar.push_back(pi);
-        }
+        int32_t pi = hget(p.span.start - 1);
+        if (pi >= 0) lpar.push_back(pi);
       }
       p.np = (int32_t)(lpar.size() - p.pstart);
     }
@@ -1805,6 +1835,30 @@ struct Walker {
         to_process.push_back(c);
     }
     consume = e.span;
+    // Zero-churn chain coalescing: while the piece just readied is idx's
+    // sole-parent successor with an LV-contiguous span (an entry run the
+    // cut pass split, or a straight chain), fold it into this consume —
+    // its diff would be empty and its frontier is just {predecessor}, so
+    // skipping the per-piece scaffolding (diff, emit lookup, graph
+    // advance) changes nothing observable.
+    while (!to_process.empty()) {
+      int32_t c = to_process.back();
+      Piece& pc = z.pieces[c];
+      if (pc.np != 1 || z.lpar[pc.pstart] != idx ||
+          pc.span.start != consume.end)
+        break;
+      to_process.pop_back();
+      pc.visited = true;
+      g_events.walk_steps++;
+      consume.end = pc.span.end;
+      idx = c;
+      z.last_head = c;
+      for (int32_t k = z.cindptr[c]; k < z.cindptr[c + 1]; k++) {
+        int32_t cc = z.cflat[k];
+        if (--z.pending[cc] == 0 && z.pieces[cc].phase == phase)
+          to_process.push_back(cc);
+      }
+    }
     return true;
   }
 };
@@ -2208,6 +2262,14 @@ struct Ctx {
   std::vector<i64> zone_common;
   // collisions of the LAST transform (survives release_tracker)
   i64 last_collisions = 0;
+  // dt_merge_into_doc's zone-everything mode (from=[] merging onto an
+  // empty doc): transform skips FF so the WHOLE history walks the zone
+  // and the final doc assembles straight from the tracker in one leaf
+  // pass — no per-op rope surgery, no out-row recording. FF's
+  // untransformed emission and the tracker walk produce the same
+  // document; this trades a little extra integrate work on the linear
+  // prefix (tiny on the shipped corpora) for dropping the rope phase.
+  bool merge_no_ff = false;
   // last dt_compose_plan / dt_compose_linear results
   std::vector<ComposedOut> composed;
   std::vector<std::pair<i64, i64>> linear_pieces;
@@ -2281,7 +2343,7 @@ static void emit_ops_range(Ctx* c, Tracker& tracker, Span consume,
               (long long)piece.lv, (long long)consumed, (int)piece.kind);
       tracker.check();
 #endif
-      if (emit)
+      if (emit && !c->merge_no_ff)
         c->out.push_back({piece.lv, consumed, piece.kind, piece.fwd, xf});
       alen -= consumed;
       if (consumed == plen) break;
@@ -2300,10 +2362,19 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
   c->zone_ff_base = false;
   std::vector<Span> new_ops, conflict_ops;
   { PROF(conflict);
-    c->zone_common = c->g.find_conflicting(
-        from, merge, [&](Span s, u8 flag) {
-          push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops, s);
-        });
+    if (from.empty() && merge == c->g.heads) {
+      // trivial checkout (the complex/merge bench shape): everything
+      // reachable from the full frontier is OnlyB in one span — skip
+      // the whole heap walk
+      if (!c->g.ends.empty()) new_ops.push_back({0, c->g.ends.back()});
+      c->zone_common.clear();
+    } else {
+      c->zone_common = c->g.find_conflicting(
+          from, merge, [&](Span s, u8 flag) {
+            push_reversed_rle(flag == Graph::OnlyB ? new_ops : conflict_ops,
+                              s);
+          });
+    }
   }
 
   std::vector<i64> next_frontier = from;
@@ -2311,7 +2382,7 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
 
   // FF mode
   std::vector<i64> ps;
-  while (!new_ops.empty()) {
+  while (!c->merge_no_ff && !new_ops.empty()) {
     Span span = new_ops.back();
     size_t i = c->g.find_idx(span.start);
     c->g.parents_at(span.start, ps);
@@ -2805,12 +2876,22 @@ i64 dt_merge_into_doc(void* p, const int32_t* init, i64 init_len,
   Ctx* c = (Ctx*)p;
   c->doc = TextBuf();
   if (init_len > 0) c->doc.insert(0, init, init_len);
+  c->merge_no_ff = (nf == 0 && init_len == 0);
   transform(c, std::vector<i64>(from, from + nf),
             std::vector<i64>(merge, merge + nm));
+  c->merge_no_ff = false;
   PROF(doc);
   size_t rope_until = c->out.size();
   bool assemble = c->zone_ff_base && c->last_tracker != nullptr;
   if (assemble) rope_until = c->ff_split;
+#ifdef DT_PROF
+  i64 ff_lvs = 0;
+  for (size_t oi = 0; oi < c->ff_split; oi++) ff_lvs += c->out[oi].len;
+  fprintf(stderr,
+          "merge_into_doc: assemble=%d rope_rows=%zu ff_split=%zu "
+          "ff_lvs=%lld\n",
+          (int)assemble, rope_until, (size_t)c->ff_split, (long long)ff_lvs);
+#endif
   for (size_t oi = 0; oi < rope_until; oi++) {
     const XfOp& x = c->out[oi];
     if (x.pos < 0) continue;
