@@ -338,10 +338,16 @@ struct Graph {
     f = out;
   }
 
+  // parents scratch for advance/retreat: one malloc per merge instead of
+  // one per call (transform advances the frontier once per walk step).
+  // Contexts are driven single-threaded (the Python side serializes per
+  // oplog), so a mutable scratch on a const method is safe here.
+  mutable std::vector<i64> ps_scratch;
+
   void advance(std::vector<i64>& f, Span rng) const {
     i64 start = rng.start;
     size_t i = find_idx(start);
-    std::vector<i64> ps;
+    std::vector<i64>& ps = ps_scratch;
     while (true) {
       i64 e_end = std::min(ends[i], rng.end);
       parents_at(start, ps);
@@ -356,7 +362,7 @@ struct Graph {
     if (span_empty(rng)) return;
     i64 start = rng.start, end = rng.end;
     size_t i = find_idx(end - 1);
-    std::vector<i64> ps;
+    std::vector<i64>& ps = ps_scratch;
     while (true) {
       i64 last_order = end - 1;
       i64 t_start = starts[i];
@@ -493,7 +499,9 @@ struct BEntry {
   }
 };
 
-static const int LEAF_CAP = 16;   // entries per leaf
+static const int LEAF_CAP = 32;   // entries per leaf (16 was best for the
+// FF-era workload; the round-5 zone-everything merge pushes whole
+// histories through the tracker and re-measured best at 32 — nn -17%)
 static const int NODE_CAP = 16;   // children per internal node
 
 struct BNode;
@@ -1620,28 +1628,35 @@ struct Zone {
       }
     }
     // 3. collect split points: every parent reference p with p+1 strictly
-    //    inside a piece forces a boundary at p+1. Gather every candidate
-    //    first, then keep the strictly-inside ones with one merge-join
-    //    over the sorted protos (p+1 strictly inside a proto implies p is
-    //    inside the same proto, so the two containment formulations are
-    //    equivalent) — no per-parent binary search.
-    std::vector<i64> cuts;
+    //    inside a piece forces a boundary at p+1. Candidates are bounded
+    //    LVs, so a bitmap gives dedup + sorted extraction for free (no
+    //    sort/unique/merge-join); p+1 strictly inside a proto implies p
+    //    is inside the same proto, so one containment form suffices.
+    i64 lv_base = protos.empty() ? 0 : protos.front().s.start;
+    i64 lv_top = protos.empty() ? 0 : protos.back().s.end;
+    // biased by lv_base so the bitmap is O(zone extent), not O(history):
+    // an incremental tail merge must not zero-fill the whole LV space
+    std::vector<uint64_t> cutbits((size_t)(lv_top - lv_base + 64) / 64, 0);
     for (const Proto& pr : protos) {
       if (!pr.entry_head) continue;  // mid-entry pieces: single parent start-1
-      for (size_t k = 0; k < g.pn(pr.gi); k++)
-        cuts.push_back(g.pb(pr.gi)[k] + 1);
-    }
-    std::sort(cuts.begin(), cuts.end());
-    cuts.erase(std::unique(cuts.begin(), cuts.end()), cuts.end());
-    {
-      size_t keep = 0, pi = 0;
-      for (i64 c : cuts) {
-        while (pi < protos.size() && protos[pi].s.end <= c) pi++;
-        if (pi < protos.size() && c > protos[pi].s.start &&
-            c < protos[pi].s.end)
-          cuts[keep++] = c;
+      for (size_t k = 0; k < g.pn(pr.gi); k++) {
+        i64 c = g.pb(pr.gi)[k] + 1 - lv_base;
+        if (c > 0 && c < lv_top - lv_base)
+          cutbits[c >> 6] |= 1ull << (c & 63);
       }
-      cuts.resize(keep);
+    }
+    std::vector<i64> cuts;
+    for (const Proto& pr : protos) {
+      i64 s = pr.s.start - lv_base, e = pr.s.end - lv_base;
+      for (i64 w = s >> 6; w <= (e - 1) >> 6; w++) {
+        uint64_t bits = cutbits[w];
+        while (bits) {
+          int b = __builtin_ctzll(bits);
+          bits &= bits - 1;
+          i64 c = (w << 6) | b;
+          if (c > s && c < e) cuts.push_back(c + lv_base);
+        }
+      }
     }
     // 4. final pieces (pgi carries each piece's graph entry from step 2,
     //    phead whether it starts that entry — saves re-searching in 5)
