@@ -530,13 +530,18 @@ def bench_linear_replay(trace: str = "automerge-paper.json.gz",
                         full: bool = True):
     """BASELINE config 1: linear single-branch trace replay.
 
-    apply = per-op append path; apply_grouped = bulk columnar ingest
-    (reference: crates/bench/src/main.rs local/apply_direct vs
+    apply = per-op append path through the NATIVE local-ingest session
+    (the editor-facing hot path, VERDICT r4 #3; reference:
+    local/apply_direct over the native push path, src/list/oplog.rs:
+    203-296); apply_python = the same per-op calls through the pure-
+    Python path (the oracle — byte-parity-gated against the native
+    session); apply_grouped = bulk columnar ingest (reference:
     local/apply_grouped_rle — the reference also pre-groups outside the
     timed apply). With full=False only the grouped ingest + checkout are
     reported (the secondary traces)."""
     from diamond_types_tpu.text.trace import (load_trace, replay_into_oplog,
-                                              replay_into_oplog_grouped)
+                                              replay_into_oplog_grouped,
+                                              replay_into_oplog_native)
     data = load_trace(os.path.join(BENCH_DATA, trace))
     data.patch_columns()  # built at parse time, outside the timed apply
     t_grouped, ol = min(
@@ -552,12 +557,22 @@ def bench_linear_replay(trace: str = "automerge-paper.json.gz",
         "parity": b.snapshot() == data.end_content,
     }
     if full:
+        t_native, ol3 = min(
+            (_timed(lambda: replay_into_oplog_native(data))
+             for _ in range(3)), key=lambda p: p[0])
+        out["apply_ops_per_sec"] = round(n / t_native)
         t0 = time.perf_counter()
         ol2 = replay_into_oplog(data)
-        out["apply_ops_per_sec"] = round(n / (time.perf_counter() - t0))
-        # the per-op path must stay parity-gated too, not just timed
+        out["apply_python_ops_per_sec"] = \
+            round(n / (time.perf_counter() - t0))
+        # the per-op paths must stay parity-gated too, not just timed —
+        # and the native session must be BYTE-identical to the Python
+        # per-op path, not merely convergent
+        from diamond_types_tpu.encoding.encode import encode_oplog
         out["parity"] = out["parity"] and \
-            ol2.checkout_tip().snapshot() == data.end_content
+            ol2.checkout_tip().snapshot() == data.end_content and \
+            ol3.checkout_tip().snapshot() == data.end_content and \
+            encode_oplog(ol3) == encode_oplog(ol2)
     return out
 
 

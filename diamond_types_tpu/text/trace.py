@@ -67,6 +67,28 @@ def replay_into_oplog(data: TestData, agent_name: str = "trace") -> OpLog:
     return ol
 
 
+def replay_into_oplog_native(data: TestData,
+                             agent_name: str = "trace") -> OpLog:
+    """Per-op replay through the native local-ingest session (reference:
+    local/apply_direct over the native push path, src/list/oplog.rs:
+    203-296 + crates/bench/src/main.rs:17-40). Same per-op call shape as
+    replay_into_oplog; the RLE/graph/arena state lands bit-identical
+    (tests/test_native_ingest.py proves encode parity)."""
+    ol = OpLog()
+    agent = ol.get_or_create_agent_id(agent_name)
+    assert not data.start_content, "traces in the corpus start empty"
+    session = ol.local_session(agent)
+    sess, ins, dele = session.hot()
+    for txn in data.txns:
+        for (pos, num_del, ins_text) in txn:
+            if num_del:
+                dele(sess, pos, pos + num_del)
+            if ins_text:
+                ins(sess, pos, ins_text)
+    session.flush()
+    return ol
+
+
 def replay_into_oplog_grouped(data: TestData,
                               agent_name: str = "trace") -> OpLog:
     """Bulk-ingest replay via OpLog.apply_local_patches (reference:

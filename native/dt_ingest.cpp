@@ -1,0 +1,319 @@
+// Native local-ingest session: the editor-typing hot path
+// (OpLog.add_insert_at / add_delete_at) at native speed.
+//
+// The reference ingests local ops in native Rust (src/list/oplog.rs:
+// 203-296 push_insert/push_delete over RleVec columns); this repo's
+// per-op Python path tops out ~300k ops/s on the automerge-paper trace
+// (BENCH_r04) because every op pays Python-object + method-call costs.
+// This module keeps a SESSION of linear local edits (one agent, typing
+// at the tip — the only shape local edits have) in C++ columnar runs,
+// RLE-merged with the EXACT rules of text/op.py can_append_ops/
+// append_ops, and drains them into the Python oplog in one bulk append
+// (graph + agent assignment collapse to a single linear chain, which is
+// what the Python path's per-op RLE would have produced anyway). The
+// drained oplog is bit-identical to one built through the per-op Python
+// path — tests/test_native_ingest.py proves semantic + encode parity.
+//
+// A CPython extension (not ctypes) because the per-call overhead is the
+// whole point: METH_FASTCALL keeps one ins() call ~100ns.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+typedef int64_t i64;
+
+const int INS = 0, DEL = 1;
+
+struct Run {
+  i64 lv;
+  int kind;
+  i64 start, end;
+  bool fwd;
+  i64 cp0, cp1;  // arena char span, cp0 < 0 => no content
+};
+
+struct Session {
+  std::vector<Run> runs;
+  std::vector<uint32_t> ins_arena;
+  std::vector<uint32_t> del_arena;
+  i64 count = 0;  // LVs appended so far
+  // Seed: a copy of the oplog's current LAST run, participating as the
+  // merge target until the first non-mergeable op. Without it the
+  // session's first merge decisions would be made against a fresh run
+  // instead of the true predecessor, and the drained RLE structure
+  // could diverge from what the per-op path builds (e.g. a backspace
+  // continuing an existing reverse run, then a delete-key op at the
+  // same position: per-op sees a reverse multi-run and starts a new
+  // run; an unseeded session would merge them as a delete-key chain).
+  bool has_seed = false;
+  bool seed_dirty = false;        // any op merged into the seed
+  Run seed{0, 0, 0, 0, true, -1, -1};
+  i64 seed_content_appended = 0;  // arena chars merged into the seed
+};
+
+// mirror of text/op.py can_append_ops (reference: op_metrics.rs:235-256);
+// b is always a fresh fwd run here (push_op pushes fwd=True), so the
+// b-side guards reduce to: rule 1's (b_len==1 or b.fwd) is always true,
+// rule 2's (b_len==1 or !b.fwd) is true only for single-item b
+inline bool can_append(const Run& a, int kind, i64 b_start, i64 b_end) {
+  i64 a_len = a.end - a.start;
+  if (a_len == 1 || a.fwd) {
+    if (kind == INS && b_start == a.end) return true;
+    if (kind == DEL && b_start == a.start) return true;
+  }
+  if (kind == DEL && (a_len == 1 || !a.fwd) && b_end - b_start == 1) {
+    if (b_end == a.start) return true;
+  }
+  return false;
+}
+
+// mirror of text/op.py append_ops (reference: op_metrics.rs:258-271)
+inline void do_append(Run& a, int kind, i64 b_start, i64 b_end, i64 b_cp1) {
+  bool fwd = b_start >= a.start && (b_start != a.start || kind == DEL);
+  a.fwd = fwd;
+  if (kind == DEL && !fwd)
+    a.start = b_start;
+  else
+    a.end += b_end - b_start;
+  if (a.cp0 >= 0 && b_cp1 >= 0) a.cp1 = b_cp1;
+}
+
+void push(Session* s, int kind, i64 start, i64 end, i64 cp0, i64 cp1) {
+  Run* prev = nullptr;
+  if (!s->runs.empty())
+    prev = &s->runs.back();
+  else if (s->has_seed)
+    prev = &s->seed;
+  if (prev && prev->kind == kind && (prev->cp0 >= 0) == (cp0 >= 0) &&
+      can_append(*prev, kind, start, end)) {
+    do_append(*prev, kind, start, end, cp1);
+    if (prev == &s->seed) {
+      s->seed_dirty = true;
+      if (cp0 >= 0) s->seed_content_appended = cp1;
+    }
+    s->count += end - start;
+    return;
+  }
+  s->runs.push_back({s->count, kind, start, end, true, cp0, cp1});
+  s->count += end - start;
+}
+
+// append a PyUnicode's code points to an arena; returns (cp0, cp1)
+bool arena_append(std::vector<uint32_t>& arena, PyObject* text, i64& cp0,
+                  i64& cp1) {
+  Py_ssize_t n = PyUnicode_GET_LENGTH(text);
+  cp0 = (i64)arena.size();
+  cp1 = cp0 + n;
+  int kind = PyUnicode_KIND(text);
+  const void* data = PyUnicode_DATA(text);
+  size_t base = arena.size();
+  arena.resize(base + (size_t)n);
+  switch (kind) {
+    case PyUnicode_1BYTE_KIND: {
+      const Py_UCS1* p = (const Py_UCS1*)data;
+      for (Py_ssize_t i = 0; i < n; i++) arena[base + i] = p[i];
+      break;
+    }
+    case PyUnicode_2BYTE_KIND: {
+      const Py_UCS2* p = (const Py_UCS2*)data;
+      for (Py_ssize_t i = 0; i < n; i++) arena[base + i] = p[i];
+      break;
+    }
+    default: {
+      const Py_UCS4* p = (const Py_UCS4*)data;
+      for (Py_ssize_t i = 0; i < n; i++) arena[base + i] = p[i];
+      break;
+    }
+  }
+  return true;
+}
+
+void sess_capsule_destroy(PyObject* cap) {
+  Session* s = (Session*)PyCapsule_GetPointer(cap, "dt_ingest.session");
+  delete s;
+}
+
+Session* get_sess(PyObject* cap) {
+  return (Session*)PyCapsule_GetPointer(cap, "dt_ingest.session");
+}
+
+// new() or new(seed_kind, seed_start, seed_end, seed_fwd, seed_has_content)
+PyObject* py_new(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+  if (nargs != 0 && nargs != 5) {
+    PyErr_SetString(PyExc_TypeError,
+                    "new([kind, start, end, fwd, has_content])");
+    return nullptr;
+  }
+  Session* s = new Session();
+  if (nargs == 5) {
+    s->has_seed = true;
+    s->seed.kind = (int)PyLong_AsLong(args[0]);
+    s->seed.start = PyLong_AsLongLong(args[1]);
+    s->seed.end = PyLong_AsLongLong(args[2]);
+    s->seed.fwd = PyObject_IsTrue(args[3]);
+    s->seed.cp0 = PyObject_IsTrue(args[4]) ? 0 : -1;
+    if (PyErr_Occurred()) { delete s; return nullptr; }
+  }
+  return PyCapsule_New(s, "dt_ingest.session", sess_capsule_destroy);
+}
+
+// ins(sess, pos, text) -> total LV count after the op
+PyObject* py_ins(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+  if (nargs != 3) {
+    PyErr_SetString(PyExc_TypeError, "ins(sess, pos, text)");
+    return nullptr;
+  }
+  Session* s = get_sess(args[0]);
+  if (!s) return nullptr;
+  i64 pos = PyLong_AsLongLong(args[1]);
+  if (pos < 0 && PyErr_Occurred()) return nullptr;
+  PyObject* text = args[2];
+  if (!PyUnicode_Check(text)) {
+    PyErr_SetString(PyExc_TypeError, "text must be str");
+    return nullptr;
+  }
+  Py_ssize_t n = PyUnicode_GET_LENGTH(text);
+  if (n <= 0) {
+    PyErr_SetString(PyExc_ValueError, "empty insert");
+    return nullptr;
+  }
+  i64 cp0, cp1;
+  arena_append(s->ins_arena, text, cp0, cp1);
+  push(s, INS, pos, pos + n, cp0, cp1);
+  return PyLong_FromLongLong(s->count);
+}
+
+// del_(sess, start, end[, content]) -> total LV count after the op
+PyObject* py_del(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+  if (nargs != 3 && nargs != 4) {
+    PyErr_SetString(PyExc_TypeError, "del_(sess, start, end[, content])");
+    return nullptr;
+  }
+  Session* s = get_sess(args[0]);
+  if (!s) return nullptr;
+  i64 start = PyLong_AsLongLong(args[1]);
+  i64 end = PyLong_AsLongLong(args[2]);
+  if (PyErr_Occurred()) return nullptr;
+  if (end <= start) {
+    PyErr_SetString(PyExc_ValueError, "empty delete");
+    return nullptr;
+  }
+  i64 cp0 = -1, cp1 = -1;
+  if (nargs == 4 && args[3] != Py_None) {
+    PyObject* content = args[3];
+    if (!PyUnicode_Check(content)) {
+      PyErr_SetString(PyExc_TypeError, "content must be str or None");
+      return nullptr;
+    }
+    if (PyUnicode_GET_LENGTH(content) != end - start) {
+      PyErr_SetString(PyExc_ValueError, "content length != delete length");
+      return nullptr;
+    }
+    arena_append(s->del_arena, content, cp0, cp1);
+  }
+  push(s, DEL, start, end, cp0, cp1);
+  return PyLong_FromLongLong(s->count);
+}
+
+PyObject* arena_to_str(const std::vector<uint32_t>& arena) {
+  // explicit little-endian byteorder: with NULL the decoder sniffs (and
+  // STRIPS) a leading U+FEFF as a BOM, silently shortening the arena;
+  // surrogatepass so lone surrogates round-trip exactly like the pure-
+  // Python path's str arenas (the server rejects them at the edge, but
+  // the session must not be stricter than the path it mirrors)
+  int byteorder = -1;
+  return PyUnicode_DecodeUTF32((const char*)arena.data(),
+                               (Py_ssize_t)arena.size() * 4,
+                               "surrogatepass", &byteorder);
+}
+
+// drain(sess) -> (runs, ins_arena, del_arena, count, seed_info);
+// resets the session. runs: list of (lv, kind, start, end, fwd, cp0,
+// cp1) with cp0=-1 for content-less runs; lv/cp session-relative
+// (base 0). seed_info: None when the seeded predecessor run was not
+// extended, else (start, end, fwd, content_appended) — the seed run's
+// final loc values and how many chars of the session's seed-kind arena
+// were merged into it.
+PyObject* py_drain(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+  if (nargs != 1) {
+    PyErr_SetString(PyExc_TypeError, "drain(sess)");
+    return nullptr;
+  }
+  Session* s = get_sess(args[0]);
+  if (!s) return nullptr;
+  PyObject* runs = PyList_New((Py_ssize_t)s->runs.size());
+  if (!runs) return nullptr;
+  for (size_t i = 0; i < s->runs.size(); i++) {
+    const Run& r = s->runs[i];
+    PyObject* t = Py_BuildValue("(LiLLOLL)", (long long)r.lv, r.kind,
+                                (long long)r.start, (long long)r.end,
+                                r.fwd ? Py_True : Py_False, (long long)r.cp0,
+                                (long long)r.cp1);
+    if (!t) { Py_DECREF(runs); return nullptr; }
+    PyList_SET_ITEM(runs, (Py_ssize_t)i, t);
+  }
+  PyObject* ins_a = arena_to_str(s->ins_arena);
+  PyObject* del_a = arena_to_str(s->del_arena);
+  if (!ins_a || !del_a) {
+    Py_XDECREF(ins_a); Py_XDECREF(del_a); Py_DECREF(runs);
+    return nullptr;
+  }
+  PyObject* seed_info;
+  if (s->seed_dirty) {
+    seed_info = Py_BuildValue("(LLOL)", (long long)s->seed.start,
+                              (long long)s->seed.end,
+                              s->seed.fwd ? Py_True : Py_False,
+                              (long long)s->seed_content_appended);
+  } else {
+    seed_info = Py_None;
+    Py_INCREF(Py_None);
+  }
+  if (!seed_info) {
+    Py_DECREF(ins_a); Py_DECREF(del_a); Py_DECREF(runs);
+    return nullptr;
+  }
+  PyObject* out = Py_BuildValue("(NNNLN)", runs, ins_a, del_a,
+                                (long long)s->count, seed_info);
+  s->runs.clear();
+  s->ins_arena.clear();
+  s->del_arena.clear();
+  s->count = 0;
+  s->has_seed = false;
+  s->seed_dirty = false;
+  s->seed_content_appended = 0;
+  return out;
+}
+
+PyObject* py_count(PyObject*, PyObject* const* args, Py_ssize_t nargs) {
+  if (nargs != 1) {
+    PyErr_SetString(PyExc_TypeError, "count(sess)");
+    return nullptr;
+  }
+  Session* s = get_sess(args[0]);
+  if (!s) return nullptr;
+  return PyLong_FromLongLong(s->count);
+}
+
+PyMethodDef methods[] = {
+    {"new", (PyCFunction)py_new, METH_FASTCALL, "new() -> session"},
+    {"ins", (PyCFunction)py_ins, METH_FASTCALL,
+     "ins(sess, pos, text) -> count"},
+    {"del_", (PyCFunction)py_del, METH_FASTCALL,
+     "del_(sess, start, end[, content]) -> count"},
+    {"drain", (PyCFunction)py_drain, METH_FASTCALL,
+     "drain(sess) -> (runs, ins_arena, del_arena, count)"},
+    {"count", (PyCFunction)py_count, METH_FASTCALL, "count(sess) -> int"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef module = {PyModuleDef_HEAD_INIT, "_dtingest",
+                      "native local-ingest session", -1, methods,
+                      nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__dtingest(void) { return PyModule_Create(&module); }

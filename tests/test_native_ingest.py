@@ -1,0 +1,233 @@
+"""Native local-ingest session parity (VERDICT r4 #3).
+
+The session (native/dt_ingest.cpp + native/ingest.py) must build an
+oplog BIT-identical to the per-op Python path — same RLE run structure,
+same arenas, same encode bytes — for any linear local edit script, at
+any flush cadence. Reference for the path being mirrored:
+src/list/oplog.rs:203-296 (native local push), op_metrics.rs:235-271
+(RLE append rules).
+"""
+
+import random
+
+import pytest
+
+from diamond_types_tpu.encoding.encode import encode_oplog
+from diamond_types_tpu.native.ingest import native_ingest_available
+from diamond_types_tpu.text.oplog import OpLog
+
+pytestmark = pytest.mark.skipif(not native_ingest_available(),
+                                reason="ingest extension unavailable")
+
+
+def _run_python(script):
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    for op in script:
+        if op[0] == "i":
+            ol.add_insert(ag, op[1], op[2])
+        elif op[0] == "d":
+            ol.add_delete_without_content(ag, op[1], op[2])
+        else:
+            ol.add_delete_at(ag, ol.version, op[1], op[2], op[3])
+    return ol
+
+
+def _run_native(script, flush_every=None):
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    s = ol.local_session(ag)
+    for k, op in enumerate(script):
+        if op[0] == "i":
+            s.insert(op[1], op[2])
+        elif op[0] == "d":
+            s.delete(op[1], op[2])
+        else:
+            s.delete(op[1], op[2], op[3])
+        if flush_every and (k + 1) % flush_every == 0:
+            s.flush()
+    s.flush()
+    return ol
+
+
+def _assert_identical(a: OpLog, b: OpLog):
+    assert len(a) == len(b)
+    ra = [(r.lv, r.kind, r.start, r.end, r.fwd, r.content_pos)
+          for r in a.ops.runs]
+    rb = [(r.lv, r.kind, r.start, r.end, r.fwd, r.content_pos)
+          for r in b.ops.runs]
+    assert ra == rb
+    assert encode_oplog(a) == encode_oplog(b)
+    assert a.checkout_tip().snapshot() == b.checkout_tip().snapshot()
+
+
+def _random_script(rng, n, alphabet="abcdef\U0001F600é"):
+    doc = []
+    script = []
+    for _ in range(n):
+        L = len(doc)
+        r = rng.random()
+        if r < 0.55 or L < 3:
+            pos = rng.randrange(L + 1)
+            txt = "".join(rng.choice(alphabet)
+                          for _ in range(rng.randrange(1, 4)))
+            script.append(("i", pos, txt))
+            doc[pos:pos] = list(txt)
+        else:
+            st = rng.randrange(L - 1)
+            en = st + rng.randrange(1, min(4, L - st) + 1)
+            if r < 0.8:
+                script.append(("d", st, en))
+            else:
+                script.append(("dc", st, en, "".join(doc[st:en])))
+            del doc[st:en]
+    return script, "".join(doc)
+
+
+@pytest.mark.parametrize("flush_every", [None, 1, 7, 100])
+def test_random_scripts_bit_identical(flush_every):
+    rng = random.Random(20260730)
+    script, end = _random_script(rng, 2500)
+    a = _run_python(script)
+    b = _run_native(script, flush_every)
+    _assert_identical(a, b)
+    assert b.checkout_tip().snapshot() == end
+
+
+def test_seeded_boundary_backspace_then_delete_key():
+    """The RLE cascade at a flush boundary: a backspace continuing the
+    oplog's existing reverse run, then a delete-key op at the same
+    position. The per-op path does NOT merge the delete-key op; an
+    unseeded session would — the seed makes the decision against the
+    true predecessor run."""
+    script = [("i", 0, "abcdefgh"),
+              ("d", 5, 7),    # fresh delete run
+              ("d", 4, 5),    # backspace continuing it (reverse chain)
+              ("d", 4, 5)]    # delete-key at the same position
+    a = _run_python(script)
+    # flush after every op so every merge crosses the seed boundary
+    b = _run_native(script, flush_every=1)
+    _assert_identical(a, b)
+
+
+def test_typing_chain_merges_into_single_runs():
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    with ol.local_session(ag) as s:
+        pos = 0
+        for ch in "hello world":
+            s.insert(pos, ch)
+            pos += 1
+    assert len(ol.ops.runs) == 1
+    assert ol.checkout_tip().snapshot() == "hello world"
+    # continuing the chain in a SECOND session must extend the same run
+    with ol.local_session(ag) as s:
+        s.insert(11, "!")
+    assert len(ol.ops.runs) == 1
+    assert ol.checkout_tip().snapshot() == "hello world!"
+
+
+def test_lv_return_values_match_python_path():
+    script = [("i", 0, "xyz"), ("d", 1, 2), ("i", 2, "qq")]
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    lvs_py = []
+    for op in script:
+        if op[0] == "i":
+            lvs_py.append(ol.add_insert(ag, op[1], op[2]))
+        else:
+            lvs_py.append(ol.add_delete_without_content(ag, op[1], op[2]))
+    ol2 = OpLog()
+    ag2 = ol2.get_or_create_agent_id("t")
+    lvs_nat = []
+    with ol2.local_session(ag2) as s:
+        for op in script:
+            if op[0] == "i":
+                lvs_nat.append(s.insert(op[1], op[2]))
+            else:
+                lvs_nat.append(s.delete(op[1], op[2]))
+    assert lvs_py == lvs_nat
+
+
+def test_bad_inputs_rejected():
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    s = ol.local_session(ag)
+    with pytest.raises(ValueError):
+        s.insert(0, "")
+    with pytest.raises(ValueError):
+        s.delete(3, 3)
+    s.insert(0, "abc")
+    with pytest.raises(ValueError):
+        s.delete(0, 2, "x")      # content length mismatch
+    s.flush()
+    assert ol.checkout_tip().snapshot() == "abc"
+
+
+def test_mutation_during_session_detected():
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    ol.add_insert(ag, 0, "base")
+    s = ol.local_session(ag)
+    s.insert(4, "x")
+    ol.add_insert(ag, 0, "sneaky")   # out-of-band mutation
+    with pytest.raises(AssertionError):
+        s.flush()
+
+
+def test_bom_and_lone_surrogate_round_trip():
+    """UTF-32 decode at drain must not sniff a leading U+FEFF as a BOM
+    (it would silently shorten the arena) and must pass lone surrogates
+    through like the pure-Python str arenas do. (Checkout of surrogate
+    content is limited the same way on BOTH paths — the native context
+    rejects it at sync, and the server rejects it at the edge — so
+    parity is asserted on the stored state, not the checkout.)"""
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    with ol.local_session(ag) as s:
+        s.insert(0, "﻿BOM")
+        s.insert(4, "a\ud800b")
+    ol2 = OpLog()
+    ag2 = ol2.get_or_create_agent_id("t")
+    ol2.add_insert(ag2, 0, "﻿BOM")
+    ol2.add_insert(ag2, 4, "a\ud800b")
+    assert ol.ops.get_run_content(ol.ops.runs[0]) == "﻿BOMa\ud800b" \
+        == ol2.ops.get_run_content(ol2.ops.runs[0])
+    assert [(r.lv, r.kind, r.start, r.end, r.fwd, r.content_pos)
+            for r in ol.ops.runs] == \
+           [(r.lv, r.kind, r.start, r.end, r.fwd, r.content_pos)
+            for r in ol2.ops.runs]
+
+
+def test_kill_switch_falls_back_to_python_session(tmp_path):
+    """DT_TPU_NO_NATIVE must make local_session() genuinely native-free
+    (same kill switch every native fast path honors)."""
+    import subprocess
+    import sys
+    code = """
+from diamond_types_tpu.text.oplog import OpLog
+from diamond_types_tpu.native.ingest import PySession
+ol = OpLog(); ag = ol.get_or_create_agent_id("t")
+s = ol.local_session(ag)
+assert isinstance(s, PySession), type(s)
+with s:
+    s.insert(0, "fallback")
+    s.delete(0, 1, "f")
+assert ol.checkout_tip().snapshot() == "allback"
+print("OK")
+"""
+    import os
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=dict(os.environ, DT_TPU_NO_NATIVE="1"))
+    assert r.returncode == 0 and "OK" in r.stdout, r.stderr[-500:]
+
+
+def test_trace_replay_native_matches_per_op():
+    from diamond_types_tpu.text.trace import (load_trace, replay_into_oplog,
+                                              replay_into_oplog_native)
+    data = load_trace(
+        "/root/reference/benchmark_data/sveltecomponent.json.gz")
+    a = replay_into_oplog(data)
+    b = replay_into_oplog_native(data)
+    _assert_identical(a, b)
+    assert b.checkout_tip().snapshot() == data.end_content
