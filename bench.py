@@ -557,22 +557,29 @@ def bench_linear_replay(trace: str = "automerge-paper.json.gz",
         "parity": b.snapshot() == data.end_content,
     }
     if full:
-        t_native, ol3 = min(
-            (_timed(lambda: replay_into_oplog_native(data))
-             for _ in range(3)), key=lambda p: p[0])
-        out["apply_ops_per_sec"] = round(n / t_native)
+        from diamond_types_tpu.native.ingest import native_ingest_available
         t0 = time.perf_counter()
         ol2 = replay_into_oplog(data)
         out["apply_python_ops_per_sec"] = \
             round(n / (time.perf_counter() - t0))
-        # the per-op paths must stay parity-gated too, not just timed —
-        # and the native session must be BYTE-identical to the Python
-        # per-op path, not merely convergent
-        from diamond_types_tpu.encoding.encode import encode_oplog
         out["parity"] = out["parity"] and \
-            ol2.checkout_tip().snapshot() == data.end_content and \
-            ol3.checkout_tip().snapshot() == data.end_content and \
-            encode_oplog(ol3) == encode_oplog(ol2)
+            ol2.checkout_tip().snapshot() == data.end_content
+        if native_ingest_available():
+            t_native, ol3 = min(
+                (_timed(lambda: replay_into_oplog_native(data))
+                 for _ in range(3)), key=lambda p: p[0])
+            out["apply_ops_per_sec"] = round(n / t_native)
+            # the native session must be BYTE-identical to the Python
+            # per-op path, not merely convergent
+            from diamond_types_tpu.encoding.encode import encode_oplog
+            out["parity"] = out["parity"] and \
+                ol3.checkout_tip().snapshot() == data.end_content and \
+                encode_oplog(ol3) == encode_oplog(ol2)
+        else:
+            # never report the PySession fallback under the native key —
+            # that would record a false native-path number
+            out["apply_ops_per_sec_error"] = \
+                "native ingest extension unavailable"
     return out
 
 

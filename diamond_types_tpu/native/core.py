@@ -153,6 +153,10 @@ def _load():
                                    ct.c_char_p, ct.c_int64, ct.c_int64,
                                    ct.c_int64]
     lib.dt_encode_full.restype = ct.c_int64
+    lib.dt_encode_patch.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_int64,
+                                    ct.c_char_p, ct.c_int64, ct.c_int64,
+                                    ct.c_int64, _i64p, ct.c_int64]
+    lib.dt_encode_patch.restype = ct.c_int64
     lib.dt_encode_fetch.argtypes = [ct.c_void_p, _u8p]
     _lib = lib
     return lib
@@ -327,6 +331,25 @@ class NativeContext:
             self._ptr, did, len(did) if did is not None else -1,
             user_data, len(user_data) if user_data is not None else -1,
             1 if store_ins else 0, 1 if compress else 0)
+        if n < 0:
+            return None
+        out = np.empty(n, dtype=np.uint8)
+        lib.dt_encode_fetch(self._ptr, out)
+        return out.tobytes()
+
+    def encode_patch(self, doc_id, user_data, store_ins: bool,
+                     compress: bool, from_version):
+        """Native v1 patch encode (encode_from; reference:
+        encode_oplog.rs:404-745) — byte-identical to the Python writer.
+        None on failure (caller falls back)."""
+        self.sync()
+        lib = self._lib
+        did = doc_id.encode("utf8") if doc_id is not None else None
+        f = np.ascontiguousarray(sorted(from_version), dtype=np.int64)
+        n = lib.dt_encode_patch(
+            self._ptr, did, len(did) if did is not None else -1,
+            user_data, len(user_data) if user_data is not None else -1,
+            1 if store_ins else 0, 1 if compress else 0, f, len(f))
         if n < 0:
             return None
         out = np.empty(n, dtype=np.uint8)

@@ -161,11 +161,21 @@ class LocalSession:
 
     def flush(self) -> None:
         """Land the pending edits in the oplog (one bulk append)."""
+        ol = self.oplog
+        if self._ext.count(self._s) == 0:
+            # nothing pending: a no-op flush just re-seeds (the oplog
+            # may legitimately have moved on since the last flush)
+            self._begin()
+            return
+        if len(ol) != self._base_lv:
+            # checked BEFORE drain (drain irreversibly resets the C++
+            # session) and with a real exception (an -O run must not
+            # land runs against a stale base LV silently)
+            raise RuntimeError(
+                f"oplog mutated during local session (base lv "
+                f"{self._base_lv}, now {len(ol)}); pending edits kept")
         runs, ins_a, del_a, count, seed = self._ext.drain(self._s)
         if count:
-            ol = self.oplog
-            assert len(ol) == self._base_lv, \
-                "oplog mutated during local session"
             ops = ol.ops
             bases = (ops.arena_len(INS), ops.arena_len(DEL))
             if ins_a:

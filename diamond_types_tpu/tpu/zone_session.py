@@ -131,6 +131,17 @@ class DeviceZoneSession:
         self.prep = prep
         W_cap = _pow2(max(int(prep.W * self.headroom), prep.W + 1024))
         n_rows = max(self.n_rows, prep.plan.indexes_used)
+        if self.row_sharding is not None:
+            # the sharded row axis must divide evenly over the mesh axes
+            # named in its spec (a real corpus's plan can need any
+            # number of index rows — e.g. friendsforever needs 12)
+            m = 1
+            spec0 = self.row_sharding.spec[0] \
+                if len(self.row_sharding.spec) else None
+            names = (spec0,) if isinstance(spec0, str) else (spec0 or ())
+            for name in names:
+                m *= int(self.row_sharding.mesh.shape[name])
+            n_rows = ((n_rows + m - 1) // m) * m
         self.W_cap = W_cap
         self.plen = prep.plen
 

@@ -2479,15 +2479,103 @@ static void transform(Ctx* c, std::vector<i64> from, std::vector<i64> merge) {
 
 // ---------------------------------------------------------------- encoder
 //
-// Native v1 full-snapshot writer (mirror of encoding/encode.py
-// encode_oplog for the from_version=[] case; format spec:
-// /root/reference/BINARY.md, reference writer src/list/encoding/
-// encode_oplog.rs). The txn walk uses this file's Zone+Walker (same
-// spanning-tree design as the Python SpanningTreeWalker); the walk order
-// may differ from the Python writer's, which changes the bytes but not
-// the decoded oplog — both writers' outputs are differential-tested
-// through decode to semantic equality. Patch encodes (from_version set)
-// stay in Python.
+// Native v1 writer — full snapshots AND patches (mirror of
+// encoding/encode.py encode_oplog; format spec: /root/reference/
+// BINARY.md, reference writer src/list/encoding/encode_oplog.rs
+// `encode` + `encode_from`). The txn walk below (StWalk) mirrors the
+// Python SpanningTreeWalker's traversal ORDER exactly, so the native
+// output is BYTE-identical to the Python writer's — pinned by
+// tests/test_encode.py.
+
+// Exact order mirror of listmerge/walker.py SpanningTreeWalker
+// (reference: src/listmerge/txn_trace.rs:75-332), track_frontier=False
+// shape: yields (consume) spans only. The Zone walker's cut refinement
+// produces a different (equally causal) order; the encoders use THIS
+// one because byte parity with the Python writer requires the same
+// traversal.
+struct StWalk {
+  struct Ent {
+    Span span;
+    int np_global;
+    std::vector<int32_t> par, child;
+    bool visited = false;
+  };
+  std::vector<Ent> input;
+  std::vector<int32_t> to_process;
+
+  int find_ent(i64 t) const {
+    int lo = 0, hi = (int)input.size();
+    while (lo < hi) {
+      int mid = (lo + hi) / 2;
+      if (t < input[mid].span.start) hi = mid;
+      else if (t >= input[mid].span.end) lo = mid + 1;
+      else return mid;
+    }
+    return -1;
+  }
+
+  // rev_spans: descending span list (diff_rev output order)
+  StWalk(const Graph& g, const std::vector<Span>& rev_spans) {
+    std::vector<i64> ps;
+    for (auto it = rev_spans.rbegin(); it != rev_spans.rend(); ++it) {
+      i64 start = it->start, end = it->end;
+      size_t i = g.find_idx(start);
+      while (start < end) {
+        i64 t_end = std::min(g.ends[i], end);
+        Ent e;
+        e.span = {start, t_end};
+        g.parents_at(start, ps);
+        e.np_global = (int)ps.size();
+        for (i64 p : ps) {
+          int pi = find_ent(p);
+          if (pi >= 0) e.par.push_back((int32_t)pi);
+        }
+        if (e.par.empty()) to_process.push_back((int32_t)input.size());
+        input.push_back(std::move(e));
+        start = t_end;
+        i++;
+      }
+    }
+    for (size_t i = 0; i < input.size(); i++)
+      for (int32_t p : input[i].par)
+        input[(size_t)p].child.push_back((int32_t)i);
+    std::reverse(to_process.begin(), to_process.end());
+  }
+
+  bool next(Span& consume) {
+    if (to_process.empty()) return false;
+    // prefer non-merge entries, most recently readied (walker.py
+    // __next__ / txn_trace.rs:243-265)
+    int32_t idx = to_process.back();
+    if (input[(size_t)idx].np_global >= 2) {
+      int found = -1;
+      for (int ii = (int)to_process.size() - 1; ii >= 0; ii--)
+        if (input[(size_t)to_process[ii]].np_global < 2) { found = ii;
+          break; }
+      if (found >= 0) {
+        idx = to_process[(size_t)found];
+        to_process[(size_t)found] = to_process.back();
+        to_process.pop_back();
+      } else {
+        to_process.pop_back();
+      }
+    } else {
+      to_process.pop_back();
+    }
+    Ent& e = input[(size_t)idx];
+    e.visited = true;
+    for (int32_t ci : e.child) {
+      Ent& ce = input[(size_t)ci];
+      if (ce.visited) continue;
+      bool ready = true;
+      for (int32_t p : ce.par)
+        if (!input[(size_t)p].visited) { ready = false; break; }
+      if (ready) to_process.push_back(ci);
+    }
+    consume = e.span;
+    return true;
+  }
+};
 
 extern "C" i64 dt_lz4_compress(const u8* src, i64 n, u8* out, i64 cap);
 extern "C" i64 dt_crc32c(const u8* data, i64 n, i64 seed);
@@ -2496,6 +2584,7 @@ namespace enc {
 
 static const u64 CH_FILEINFO = 1, CH_DOCID = 2, CH_AGENTNAMES = 3,
                  CH_USERDATA = 4, CH_COMPRESSED = 5, CH_STARTBRANCH = 10,
+                 CH_VERSION = 12,
                  CH_CONTENT_COMPRESSED = 14, CH_PATCHES = 20,
                  CH_OP_VERSIONS = 21, CH_OP_TYPE_POS = 22,
                  CH_OP_PARENTS = 23, CH_PATCH_CONTENT = 24,
@@ -2561,9 +2650,9 @@ static void write_op(Buf& out, u8 kind, i64 start, i64 end, bool fwd,
 
 }  // namespace enc
 
-static i64 encode_full_impl(Ctx* c, const u8* docid, i64 docid_len,
-                            const u8* userdata, i64 ud_len, bool store_ins,
-                            bool compress) {
+static i64 encode_impl(Ctx* c, const u8* docid, i64 docid_len,
+                       const u8* userdata, i64 ud_len, bool store_ins,
+                       bool compress, const std::vector<i64>& from_version) {
   using namespace enc;
   Graph& g = c->g;
   Agents& aa = c->aa;
@@ -2670,14 +2759,19 @@ static i64 encode_full_impl(Ctx* c, const u8* docid, i64 docid_len,
     }
   };
 
-  // ---- main walk: whole graph as one fresh span list ----
-  if (top > 0) {
-    std::vector<Span> fresh{{0, top}};
-    Zone zone(g, {}, fresh);
-    Walker w(zone, 1);
-    std::vector<Span> retreat, advance_rev;
+  // ---- main walk: spans above from_version, SpanningTreeWalker order
+  std::vector<Span> walk_spans;
+  if (from_version.empty()) {
+    if (top > 0) walk_spans.push_back({0, top});
+  } else {
+    std::vector<Span> only_a;
+    g.diff_rev(from_version, g.heads, only_a, walk_spans);
+    if (!only_a.empty()) return -2;  // from_version not an ancestor
+  }
+  {
+    StWalk w(g, walk_spans);
     Span consume;
-    while (w.next(retreat, advance_rev, consume)) {
+    while (w.next(consume)) {
       if (span_empty(consume)) continue;
       // 1. agent assignment runs
       i64 pos = consume.start;
@@ -2773,6 +2867,24 @@ static i64 encode_full_impl(Ctx* c, const u8* docid, i64 docid_len,
     patches.chunk(CH_PATCH_CONTENT, body.b);
   }
 
+  // start branch BEFORE fileinfo: mapping the from version's agents may
+  // append to names_buf, which fileinfo's CH_AGENTNAMES bakes below —
+  // same build order as the Python writer (walk-first-use numbering,
+  // then any from-only agents). Patch encodes carry no start-branch
+  // content (ENCODE_PATCH).
+  Buf start_branch;
+  if (!from_version.empty()) {
+    Buf vbuf;
+    for (size_t i = 0; i < from_version.size(); i++) {
+      bool has_more = i + 1 < from_version.size();
+      i64 agent, seq;
+      aa.local_to_agent(from_version[i], agent, seq);
+      vbuf.leb(mix((u64)map_agent(agent), has_more));
+      vbuf.leb((u64)seq);
+    }
+    start_branch.chunk(CH_VERSION, vbuf.b);
+  }
+
   Buf fileinfo;
   if (docid_len >= 0) {
     Buf d;
@@ -2802,7 +2914,7 @@ static i64 encode_full_impl(Ctx* c, const u8* docid, i64 docid_len,
     result.chunk(CH_COMPRESSED, comp.b);
   }
   result.chunk(CH_FILEINFO, fileinfo.b);
-  result.chunk(CH_STARTBRANCH, {});  // from_version = [] -> empty
+  result.chunk(CH_STARTBRANCH, start_branch.b);
   patches.chunk(CH_OP_VERSIONS, agent_chunk.b);
   patches.chunk(CH_OP_TYPE_POS, ops_chunk.b);
   patches.chunk(CH_OP_PARENTS, txns_chunk.b);
@@ -3195,8 +3307,19 @@ void dt_fetch_linear(void* p, i64* lv, i64* len) {
 i64 dt_encode_full(void* p, const u8* docid, i64 docid_len,
                    const u8* userdata, i64 ud_len, i64 store_ins,
                    i64 compress) {
-  return encode_full_impl((Ctx*)p, docid, docid_len, userdata, ud_len,
-                          store_ins != 0, compress != 0);
+  return encode_impl((Ctx*)p, docid, docid_len, userdata, ud_len,
+                     store_ins != 0, compress != 0, {});
+}
+
+// Patch encode (reference: encode_oplog.rs encode_from): ops above
+// `from` only, start branch = `from` as agent versions, no start-branch
+// content. Returns -2 when `from` is not an ancestor of the oplog tip.
+i64 dt_encode_patch(void* p, const u8* docid, i64 docid_len,
+                    const u8* userdata, i64 ud_len, i64 store_ins,
+                    i64 compress, const i64* from, i64 nf) {
+  return encode_impl((Ctx*)p, docid, docid_len, userdata, ud_len,
+                     store_ins != 0, compress != 0,
+                     std::vector<i64>(from, from + nf));
 }
 
 void dt_encode_fetch(void* p, u8* out) {

@@ -164,6 +164,22 @@ def test_bad_inputs_rejected():
     assert ol.checkout_tip().snapshot() == "abc"
 
 
+def test_noop_flush_after_external_edit_reseeds():
+    """A flush with nothing pending is a no-op that re-seeds — an
+    out-of-band oplog edit between flushes must not fail a clean
+    context-manager exit."""
+    ol = OpLog()
+    ag = ol.get_or_create_agent_id("t")
+    with ol.local_session(ag) as s:
+        s.insert(0, "a")
+        s.flush()
+        ol.add_insert(ag, 0, "b")
+        # and a SECOND batch after re-seeding still lands correctly
+        s.flush()
+        s.insert(0, "c")
+    assert ol.checkout_tip().snapshot() == "cba"
+
+
 def test_mutation_during_session_detected():
     ol = OpLog()
     ag = ol.get_or_create_agent_id("t")
@@ -171,8 +187,10 @@ def test_mutation_during_session_detected():
     s = ol.local_session(ag)
     s.insert(4, "x")
     ol.add_insert(ag, 0, "sneaky")   # out-of-band mutation
-    with pytest.raises(AssertionError):
+    with pytest.raises(RuntimeError):
         s.flush()
+    # the check fires BEFORE drain: pending edits survive the failure
+    assert s.pending() == 1
 
 
 def test_bom_and_lone_surrogate_round_trip():
