@@ -256,6 +256,45 @@ def _utf8_clean(s: str) -> bool:
         return False
 
 
+def _patch_agent_names(data: bytes):
+    """Agent names declared by a v1 patch/snapshot blob (CHUNK_AGENTNAMES
+    inside CHUNK_FILEINFO), WITHOUT applying the patch — push validation
+    must run before decode_into mutates the live oplog."""
+    from ..encoding.decode import (Buf, CHUNK_AGENTNAMES, CHUNK_FILEINFO,
+                                   MAGIC)
+    if data[:8] != MAGIC:
+        raise ValueError("bad magic")
+    buf = Buf(data, 8)
+    buf.next_usize()   # protocol version
+    names = []
+    while not buf.is_empty():
+        ctype, chunk = buf.next_chunk()
+        if ctype != CHUNK_FILEINFO:
+            continue
+        while not chunk.is_empty():
+            ct2, c2 = chunk.next_chunk()
+            if ct2 == CHUNK_AGENTNAMES:
+                while not c2.is_empty():
+                    names.append(c2.next_str())
+        break
+    return names
+
+
+def _agent_name_ok(s) -> bool:
+    """Agent names additionally must be BMP-only: agent ordering is a
+    CONVERGENCE tie-break, Python/native compare code points while the
+    browser engine's `<` compares UTF-16 units, and the two orders
+    diverge exactly on astral characters. The engine's single source
+    (tools/crdt_replay_src.py) documents this edge as its precondition;
+    this is where it is enforced."""
+    if not (isinstance(s, str) and s and _utf8_clean(s)):
+        return False
+    for ch in s:
+        if ord(ch) > 0xFFFF:
+            return False
+    return True
+
+
 def _crdt_next_seq(aa, agent: int) -> int:
     nxt = 0
     for (lv0, lv1, ag, seq0) in aa.global_runs:
@@ -277,7 +316,7 @@ def _crdt_apply_op(ol: OpLog, op: dict, cache: Optional[dict] = None) -> None:
     store.lock, stalling every other endpoint."""
     from operator import index as _ix
     name = op["agent"]
-    if not (isinstance(name, str) and name and _utf8_clean(name)):
+    if not _agent_name_ok(name):
         raise ValueError("bad agent name")
     seq = _ix(op["seq"])
     aa = ol.cg.agent_assignment
@@ -519,6 +558,17 @@ class SyncHandler(BaseHTTPRequestHandler):
                 patch = encode_oplog(ol, ENCODE_PATCH, from_version=common)
             return self._send(200, patch, "application/octet-stream")
         if action == "push":
+            # the binary path must enforce the same agent-name rules as
+            # the JSON paths — a patch can register brand-new agents, and
+            # an astral name would poison browser-vs-server convergence
+            # for the whole doc (see _agent_name_ok)
+            try:
+                bad = [n for n in _patch_agent_names(body)
+                       if not _agent_name_ok(n)]
+            except Exception:
+                return self._send(400, b'{"error": "bad patch"}')
+            if bad:
+                return self._send(400, b'{"error": "bad agent name"}')
             with self.store.lock:
                 pre = list(ol.version)
                 decode_into(ol, body)
@@ -557,8 +607,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                     ops.append(("del", _ix(op["start"]), _ix(op["end"])))
                 else:
                     return self._send(400, b'{"error": "bad op"}')
-            if not isinstance(req.get("agent"), str) or not req["agent"] \
-                    or not _utf8_clean(req["agent"]):
+            if not _agent_name_ok(req.get("agent")):
                 return self._send(400, b'{"error": "bad agent"}')
             with self.store.lock:
                 frontier = list(ol.cg.remote_to_local_frontier(

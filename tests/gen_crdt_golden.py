@@ -146,14 +146,14 @@ def fuzz_vector(seed, steps=40):
 
 MJS_TEMPLATE = '''// AUTO-GENERATED by tests/gen_crdt_golden.py — do not edit.
 // Standalone conformance runner for the in-browser CRDT engine: embeds
-// the EXACT engine source shipped in web_assets.CRDT_HTML and replays
-// the golden vectors from crdt_client_golden.json. Run with node:
+// the EXACT engine shipped in web_assets.CRDT_HTML (itself GENERATED
+// from tools/crdt_replay_src.py — the single source the Python suites
+// execute) and replays the golden vectors from crdt_client_golden.json.
+// Run with node:
 //    node crdt_conformance.mjs
 import {{ readFileSync }} from "fs";
 import {{ dirname, join }} from "path";
 import {{ fileURLToPath }} from "url";
-
-const AGENT = "conformance";   // engine slice references it in localOp
 
 {engine}
 
@@ -162,10 +162,7 @@ const fixture = JSON.parse(readFileSync(
   "utf8"));
 let fail = 0;
 for (const v of fixture.vectors) {{
-  eng.ops = []; eng.byKey = new Map();
-  eng.nextSeq = 0; eng.unpushed = 0; eng.frontier = [];
-  for (const op of v.ops) addOp(op);
-  const got = replay();
+  const got = replay(v.ops);
   if (got !== v.expect) {{
     fail++;
     console.error(`FAIL ${{v.name}}: got ${{JSON.stringify(got)}} ` +
@@ -197,9 +194,13 @@ def main():
             f"mirror disagrees with oracle on {v['name']}: " \
             f"{got!r} != {v['expect']!r}"
 
+    import inspect
+
+    from diamond_types_tpu.tools import crdt_replay_src
     engine = crdt_engine_js()
+    src_text = inspect.getsource(crdt_replay_src)
     fixture = {
-        "js_sha256": hashlib.sha256(engine.encode("utf8")).hexdigest(),
+        "src_sha256": hashlib.sha256(src_text.encode("utf8")).hexdigest(),
         "generator": "tests/gen_crdt_golden.py",
         "vectors": vectors,
     }
