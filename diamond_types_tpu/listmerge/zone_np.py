@@ -57,6 +57,24 @@ class ZonePrep:
     pool: np.ndarray          # int32 [W] char codes by slot
     agent_k: np.ndarray       # int64 [W] agent name rank (-1 prefix)
     seq_k: np.ndarray         # int64 [W] agent-local seq
+    # native handle for the C++ tape packer (None = Python pack); set by
+    # prepare_zone when the oplog has a native context
+    native_ctx: object = None
+    # compose-cache identity at prepare time (0 = no native compose);
+    # the packer only reads the ctx cache when this still matches
+    compose_serial: int = 0
+    # back-reference for lazy composed-entry fetch (get_composed)
+    oplog: object = None
+
+    def get_composed(self):
+        """The per-entry composition results, fetched lazily: the
+        flagship device path (prepare -> native pack -> execute) never
+        needs them Python-side, so prepare_zone(fetch_composed=False)
+        skips the column round-trip; consumers that DO need them
+        (ZoneExec, the Python packer, sessions) land here."""
+        if self.composed is None:
+            self.composed = compose_plan(self.oplog, self.plan)
+        return self.composed
 
 
 def _slot_of(prep: ZonePrep, lvs: np.ndarray) -> np.ndarray:
@@ -70,7 +88,8 @@ def _slot_of(prep: ZonePrep, lvs: np.ndarray) -> np.ndarray:
 def prepare_zone(oplog, from_frontier: Sequence[int] = (),
                  merge_frontier: Optional[Sequence[int]] = None,
                  prefix: Optional[str] = None,
-                 pin_lvs: Sequence[int] = ()) -> ZonePrep:
+                 pin_lvs: Sequence[int] = (),
+                 fetch_composed: bool = True) -> ZonePrep:
     """Host pass: plan + composition + slot/pool/key tables.
 
     `prefix` overrides the doc at the zone's common ancestor (an
@@ -83,7 +102,6 @@ def prepare_zone(oplog, from_frontier: Sequence[int] = (),
         else list(merge_frontier)
     plan = compile_plan2(oplog.cg.graph, list(from_frontier), merge,
                          pin_lvs=tuple(pin_lvs))
-    composed = compose_plan(oplog, plan)
 
     if prefix is None:
         if not plan.entries:
@@ -99,6 +117,23 @@ def prepare_zone(oplog, from_frontier: Sequence[int] = (),
             # Computed with this same engine, recursively (the recursion
             # bottoms out in pure-ff or empty-common plans).
             prefix, _ = zone_checkout_np(oplog, (), list(plan.common))
+    # compose LAST: the prefix recursion above may run compose_plan for
+    # its own zone, and the native packer reads the ctx's compose cache —
+    # composing here leaves THIS plan's entries as the cached set. With
+    # fetch_composed=False only the native cache is populated (the
+    # column round-trip to Python is deferred to get_composed).
+    from ..native import native_ctx_or_none
+    nctx = native_ctx_or_none(oplog)
+    composed = None
+    serial = 0
+    if not fetch_composed and nctx is not None:
+        spans = [en.span for en in plan.entries]
+        if nctx.compose_cache_only(spans):
+            serial = nctx.compose_serial()
+    if serial == 0:
+        composed = compose_plan(oplog, plan)
+        if nctx is not None:
+            serial = nctx.compose_serial()
     plen = len(prefix)
 
     # zone insert runs -> slot map + pool
@@ -143,7 +178,8 @@ def prepare_zone(oplog, from_frontier: Sequence[int] = (),
 
     return ZonePrep(plan=plan, composed=composed, prefix=prefix, plen=plen,
                     W=W, ins_lv0=ins_lv0, ins_cum=ins_cum, pool=pool,
-                    agent_k=agent_k, seq_k=seq_k)
+                    agent_k=agent_k, seq_k=seq_k, native_ctx=nctx,
+                    compose_serial=serial, oplog=oplog)
 
 
 class ZoneExec:
@@ -358,7 +394,7 @@ class ZoneExec:
             elif op == DROP:
                 pass
             elif op == APPLY:
-                self.apply_entry(act[2], self.prep.composed[act[1]])
+                self.apply_entry(act[2], self.prep.get_composed()[act[1]])
 
     def text(self) -> str:
         vis = self.ever[self.ord] == 0

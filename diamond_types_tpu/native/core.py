@@ -26,6 +26,22 @@ def _load():
     if path is None or not os.path.exists(path):
         return None
     lib = ct.CDLL(path)
+    try:
+        _configure(lib)
+    except AttributeError as e:
+        # an .so predating the current symbol set (e.g. checkout with
+        # equal mtimes skipping the rebuild): degrade to the Python
+        # fallbacks instead of crashing every native call site
+        import sys
+        sys.stderr.write(f"stale native library ({e}); native paths "
+                         f"disabled — rebuild with python -m "
+                         f"diamond_types_tpu.native.build --force\n")
+        return None
+    _lib = lib
+    return lib
+
+
+def _configure(lib) -> None:
     lib.dt_ctx_new.restype = ct.c_void_p
     lib.dt_ctx_free.argtypes = [ct.c_void_p]
     lib.dt_add_agent.argtypes = [ct.c_void_p, ct.c_char_p]
@@ -142,6 +158,8 @@ def _load():
     lib.dt_compose_plan.argtypes = [ct.c_void_p, ct.c_int64, _i64p, _i64p]
     lib.dt_compose_plan.restype = ct.c_int64
     lib.dt_compose_counts.argtypes = [ct.c_void_p, _i64p]
+    lib.dt_compose_serial.argtypes = [ct.c_void_p]
+    lib.dt_compose_serial.restype = ct.c_int64
     lib.dt_compose_fetch.argtypes = [
         ct.c_void_p, _i64p, _i64p, _i32p, _u8p, _u8p, _i64p, _i32p,
         _i64p, _i64p, _i32p, _i64p, _i32p, _i32p,
@@ -158,8 +176,18 @@ def _load():
                                     ct.c_int64, _i64p, ct.c_int64]
     lib.dt_encode_patch.restype = ct.c_int64
     lib.dt_encode_fetch.argtypes = [ct.c_void_p, _u8p]
-    _lib = lib
-    return lib
+    lib.dt_zone_pack.argtypes = [
+        ct.c_void_p, ct.c_int64, _i64p, _i64p, _i64p,          # actions
+        ct.c_int64, _i64p,                                      # counts
+        _i64p, _i64p, _u8p, _i64p, _i32p, _i64p,                # q + ch cols
+        _i32p, _i64p, _i32p, _i32p,                             # blk cols
+        _i64p, _i64p, _i64p, _i64p,                             # del cols
+        ct.c_int64, _i64p, _i64p, ct.c_int64,                   # slot map
+        _i64p, _i64p,                                           # keys
+        ct.c_int64, ct.c_int64, ct.c_int64, ct.c_int64]  # MB MC MD cache
+    lib.dt_zone_pack.restype = ct.c_int64
+    lib.dt_zone_pack_fetch.argtypes = [ct.c_void_p] + [_i32p] * 19 + [
+        ct.c_int64, ct.c_int64, ct.c_int64]
 
 
 def native_available() -> bool:
@@ -250,6 +278,25 @@ class NativeContext:
         frontier = [int(x) for x in fbuf[:k]]
         return lv, ln, kind, fwd, pos, frontier
 
+
+    def compose_serial(self) -> int:
+        """Identity of the current native compose cache (bumped by every
+        dt_compose_plan) — the zone packer validates it before packing
+        from the cache."""
+        return int(self._lib.dt_compose_serial(self._ptr))
+
+    def compose_cache_only(self, spans) -> bool:
+        """Run the native composer, leaving results ONLY in the ctx
+        cache (no Python column round-trip) — the zone packer reads
+        them in place. False = unsupported input (caller composes via
+        the normal path)."""
+        self.sync()
+        n = len(spans)
+        s0 = np.ascontiguousarray(
+            [s for s, _ in spans] or [0], dtype=np.int64)
+        s1 = np.ascontiguousarray(
+            [e for _, e in spans] or [0], dtype=np.int64)
+        return self._lib.dt_compose_plan(self._ptr, n, s0, s1) == 0
 
     def compose_plan(self, spans):
         """Native zone-engine composer (listmerge/compose.py's hot path in

@@ -165,7 +165,10 @@ class _Emitter(ast.NodeVisitor):
                 _fail(s, "multiple assignment targets")
             t = s.targets[0]
             if isinstance(t, ast.Name):
-                self.out(f"var {t.id} = {self.expr(s.value)};")
+                # Name assignments are handled (with declared-name
+                # tracking) by stmt_hoisted — reaching here would bypass
+                # the hoisting contract
+                _fail(s, "name assignment outside hoisting path")
             elif isinstance(t, ast.Subscript):
                 self.out(f"{self.expr(t)} = {self.expr(s.value)};")
             else:

@@ -18,6 +18,7 @@
 // LV spans and positions.
 
 #include <algorithm>
+#include <array>
 #include <cassert>
 #include <cstdint>
 #include <cstring>
@@ -2261,6 +2262,29 @@ struct Composer {
   }
 };
 
+namespace zonepack {
+
+struct Step {
+  int32_t op, a, b, snap;
+  std::vector<std::array<int32_t, 5>> blocks;  // cursor, prev, root, start, len
+  std::vector<std::array<int32_t, 7>> chars;   // slot, ol_s, ol_c, orr, blk, ag, sq
+  std::vector<std::array<int32_t, 3>> dels;    // kind, a, b
+};
+
+struct PackState {
+  std::vector<Step> steps;
+  i64 MB, MC, MD;
+  Step* cur = nullptr;
+
+  Step* new_step(int32_t op, int32_t a, int32_t b, int32_t snap) {
+    steps.push_back(Step{op, a, b, snap, {}, {}, {}});
+    cur = &steps.back();
+    return cur;
+  }
+};
+
+}  // namespace zonepack
+
 struct Ctx {
   Graph g;
   Agents aa;
@@ -2277,6 +2301,12 @@ struct Ctx {
   std::vector<i64> zone_common;
   // collisions of the LAST transform (survives release_tracker)
   i64 last_collisions = 0;
+  // dt_zone_pack's two-call fetch buffer
+  std::vector<zonepack::Step> pack_steps;
+  // compose-cache identity: bumped by every dt_compose_plan; the packer
+  // validates it so a cache from a DIFFERENT plan (same entry count)
+  // can never be packed silently
+  i64 compose_serial = 0;
   // dt_merge_into_doc's zone-everything mode (from=[] merging onto an
   // empty doc): transform skips FF so the WHOLE history walks the zone
   // and the final doc assembles straight from the tracker in one leaf
@@ -3206,6 +3236,7 @@ i64 dt_last_collisions(void* p) { return ((Ctx*)p)->last_collisions; }
 // runs / out-of-range positions) — caller falls back to Python.
 i64 dt_compose_plan(void* p, i64 n, const i64* s0, const i64* s1) {
   Ctx* c = (Ctx*)p;
+  c->compose_serial++;
   c->composed.clear();
   c->composed.resize((size_t)n);
   for (i64 k = 0; k < n; k++) {
@@ -3218,6 +3249,8 @@ i64 dt_compose_plan(void* p, i64 n, const i64* s0, const i64* s1) {
   }
   return 0;
 }
+
+i64 dt_compose_serial(void* p) { return ((Ctx*)p)->compose_serial; }
 
 void dt_compose_counts(void* p, i64* out) {
   Ctx* c = (Ctx*)p;
@@ -3265,6 +3298,273 @@ void dt_compose_fetch(void* p, i64* q, i64* ch_lv, int32_t* ch_block,
   }
   c->composed.clear();
   c->composed.shrink_to_fit();
+}
+
+// ---------------------------------------------------------------- zone pack
+//
+// Native zone tape packer (VERDICT r4 #6 — the ~280 ms pure-Python
+// pack was the zone engine's remaining host-prep cost): flattens a
+// prepared zone (plan actions + composed entries) into the micro-step
+// tape arrays of diamond_types_tpu/tpu/zone_kernel.py::pack_zone_tape,
+// ARRAY-IDENTICAL to the Python packer (pinned by
+// tests/test_zone_kernel.py). Composed entries arrive as the
+// entry-concatenated flat columns (counts-prefixed, same layout as
+// dt_compose_fetch) so the packer serves both the native and the
+// Python fallback composer.
+
+
+// action columns: kind (plan2 BEGIN=0 FORK=1 MAX=2 DROP=3 APPLY=4),
+// a, b per plan.actions semantics. Composed flat columns per the
+// counts[5*n] layout. slot map: ins_lv0/ins_cum sorted run table.
+// Returns total step count; the caller fetches with dt_zone_pack_fetch
+// on the same ctx (the step buffer lives on the ctx — single-threaded
+// per ctx, like every other two-call protocol in this file).
+// use_cache: read composed entries straight from the ctx's compose
+// cache (populated by the immediately-preceding dt_compose_plan) and
+// ignore the flat column pointers (they may be null).
+extern "C" i64 dt_zone_pack(
+    void* p, i64 n_actions, const i64* act_kind, const i64* act_a, const i64* act_b,
+    i64 n_entries, const i64* counts, const i64* flat_q, const i64* ch_lv,
+    const u8* ch_kind, const i64* ch_anchor, const int32_t* ch_q,
+    const i64* ch_orrown, const int32_t* blk_root_q, const i64* blk_root_lv,
+    const int32_t* blk_start, const int32_t* blk_len, const i64* db0,
+    const i64* db1, const i64* do0, const i64* do1, i64 n_runs,
+    const i64* ins_lv0, const i64* ins_cum, i64 plen, const i64* agent_k,
+    const i64* seq_k, i64 MB, i64 MC, i64 MD, i64 use_cache) {
+  // use_cache > 0 is the expected compose serial: both the entry count
+  // AND the cache identity must match (two plans can have equal counts)
+  Ctx* cx = (Ctx*)p;
+  if (use_cache && ((i64)cx->composed.size() != n_entries ||
+                    cx->compose_serial != use_cache))
+    return -2;  // stale/absent cache: caller re-marshals
+  using zonepack::Step;
+  const int K_OWN = 1;
+  const int OP_BEGIN = 0, OP_FORK = 1, OP_MAX = 2, OP_APPLY = 3;
+  const int A_BEGIN = 0, A_FORK = 1, A_MAX = 2, A_DROP = 3, A_APPLY = 4;
+
+  auto slot_of = [&](i64 lv) -> i64 {
+    // searchsorted(ins_lv0, lv, 'right') - 1
+    const i64* hi = std::upper_bound(ins_lv0, ins_lv0 + n_runs, lv);
+    i64 j = (hi - ins_lv0) - 1;
+    return plen + ins_cum[j] + (lv - ins_lv0[j]);
+  };
+
+  // per-entry offsets into the flat columns (marshalled path only)
+  std::vector<i64> off_q, off_ch, off_blk, off_db, off_do;
+  if (!use_cache) {
+    off_q.assign(n_entries + 1, 0); off_ch.assign(n_entries + 1, 0);
+    off_blk.assign(n_entries + 1, 0); off_db.assign(n_entries + 1, 0);
+    off_do.assign(n_entries + 1, 0);
+    for (i64 k = 0; k < n_entries; k++) {
+      off_q[k + 1] = off_q[k] + counts[k * 5 + 0];
+      off_ch[k + 1] = off_ch[k] + counts[k * 5 + 1];
+      off_blk[k + 1] = off_blk[k] + counts[k * 5 + 2];
+      off_db[k + 1] = off_db[k] + counts[k * 5 + 3];
+      off_do[k + 1] = off_do[k] + counts[k * 5 + 4];
+    }
+  }
+
+  // uniform per-entry view over either source
+  struct EView {
+    const i64* q; i64 nq;
+    const i64 *lv, *anchor, *orrown; const u8* kind;
+    const int32_t* qidx; i64 nc;
+    const int32_t *brq, *bstart, *blen; const i64* brlv; i64 nb;
+    const i64 *pdb0, *pdb1; i64 ndb;
+    const i64 *pdo0, *pdo1; i64 ndo;
+  };
+  auto view_of = [&](i64 e) -> EView {
+    EView v;
+    if (use_cache) {
+      const ComposedOut& o = cx->composed[(size_t)e];
+      v.q = o.q_cursor.data(); v.nq = (i64)o.q_cursor.size();
+      v.lv = o.ch_lv.data(); v.anchor = o.ch_anchor.data();
+      v.orrown = o.ch_orrown.data(); v.kind = o.ch_kind.data();
+      v.qidx = o.ch_q.data(); v.nc = (i64)o.ch_lv.size();
+      v.brq = o.blk_root_q.data(); v.bstart = o.blk_start.data();
+      v.blen = o.blk_len.data(); v.brlv = o.blk_root_lv.data();
+      v.nb = (i64)o.blk_start.size();
+      v.pdb0 = o.db0.data(); v.pdb1 = o.db1.data();
+      v.ndb = (i64)o.db0.size();
+      v.pdo0 = o.do0.data(); v.pdo1 = o.do1.data();
+      v.ndo = (i64)o.do0.size();
+    } else {
+      v.q = flat_q + off_q[e]; v.nq = counts[e * 5 + 0];
+      v.lv = ch_lv + off_ch[e]; v.anchor = ch_anchor + off_ch[e];
+      v.orrown = ch_orrown + off_ch[e]; v.kind = ch_kind + off_ch[e];
+      v.qidx = ch_q + off_ch[e]; v.nc = counts[e * 5 + 1];
+      v.brq = blk_root_q + off_blk[e]; v.bstart = blk_start + off_blk[e];
+      v.blen = blk_len + off_blk[e]; v.brlv = blk_root_lv + off_blk[e];
+      v.nb = counts[e * 5 + 2];
+      v.pdb0 = db0 + off_db[e]; v.pdb1 = db1 + off_db[e];
+      v.ndb = counts[e * 5 + 3];
+      v.pdo0 = do0 + off_do[e]; v.pdo1 = do1 + off_do[e];
+      v.ndo = counts[e * 5 + 4];
+    }
+    return v;
+  };
+
+  zonepack::PackState ps;
+  ps.MB = MB; ps.MC = MC; ps.MD = MD;
+  ps.steps.reserve((size_t)n_actions * 2);
+
+  for (i64 ai = 0; ai < n_actions; ai++) {
+    i64 kind = act_kind[ai];
+    if (kind == A_BEGIN) {
+      ps.new_step(OP_BEGIN, (int32_t)act_a[ai], 0, 0);
+    } else if (kind == A_FORK) {
+      ps.new_step(OP_FORK, (int32_t)act_a[ai], (int32_t)act_b[ai], 0);
+    } else if (kind == A_MAX) {
+      // tape a = src, b = dst (zone_kernel.py:257)
+      ps.new_step(OP_MAX, (int32_t)act_b[ai], (int32_t)act_a[ai], 0);
+    } else if (kind == A_DROP) {
+      continue;
+    } else if (kind == A_APPLY) {
+      i64 e = act_a[ai];
+      int32_t row = (int32_t)act_b[ai];
+      Step* cur = ps.new_step(OP_APPLY, row, 0, 1);
+      auto next_sub = [&]() { return ps.new_step(OP_APPLY, row, 0, 0); };
+
+      const EView v = view_of(e);
+      auto q_at = [&](i64 qi) -> i64 {
+        // Python: flat_q[clip(ch_q, 0, None)] with a zeros(1) fallback
+        // when the entry has no queries
+        if (v.nq == 0) return 0;
+        return v.q[qi >= 0 ? qi : 0];
+      };
+      auto char_cols = [&](i64 pos, int32_t* out7, int32_t blk) {
+        i64 slot = slot_of(v.lv[pos]);
+        int kd = v.kind[pos];
+        i64 anchor = v.anchor[pos] >= 0 ? slot_of(v.anchor[pos]) : -1;
+        i64 orr = v.orrown[pos] >= 0 ? slot_of(v.orrown[pos]) : -1;
+        i64 c_of = q_at(v.qidx[pos]);
+        i64 ol_static, ol_coord;
+        if (kd == 0) ol_static = slot - 1;
+        else if (kd == K_OWN) ol_static = anchor;
+        else ol_static = (c_of == 0) ? -1 : -2;
+        ol_coord = (kd >= 2 && c_of > 0) ? c_of : 0;
+        out7[0] = (int32_t)slot;
+        out7[1] = (int32_t)ol_static;
+        out7[2] = (int32_t)ol_coord;
+        out7[3] = (int32_t)orr;
+        out7[4] = blk;
+        out7[5] = (int32_t)agent_k[slot];
+        out7[6] = (int32_t)seq_k[slot];
+      };
+
+      if (v.nc) {
+        for (i64 b = 0; b < v.nb; b++) {
+          i64 lo = v.bstart[b];
+          i64 hi = lo + v.blen[b];
+          bool first = true;
+          i64 pos = lo;
+          while (pos < hi) {
+            if ((i64)cur->blocks.size() >= MB ||
+                (i64)cur->chars.size() >= MC)
+              cur = next_sub();
+            i64 take = std::min(hi - pos, MC - (i64)cur->chars.size());
+            int32_t cursor = first ? (int32_t)v.q[v.brq[b]] : -2;
+            int32_t prev = first ? -1 : (int32_t)slot_of(v.lv[pos - 1]);
+            cur->blocks.push_back(std::array<int32_t, 5>{{
+                cursor, prev, (int32_t)slot_of(v.brlv[b]),
+                (int32_t)cur->chars.size(), (int32_t)take}});
+            int32_t blk = (int32_t)cur->blocks.size() - 1;
+            for (i64 k = 0; k < take; k++) {
+              std::array<int32_t, 7> row7;
+              char_cols(pos + k, row7.data(), blk);
+              cur->chars.push_back(row7);
+            }
+            pos += take;
+            first = false;
+          }
+        }
+      }
+      for (i64 d = 0; d < v.ndb; d++) {
+        if ((i64)cur->dels.size() >= MD) cur = next_sub();
+        cur->dels.push_back(std::array<int32_t, 3>{{
+            0, (int32_t)v.pdb0[d], (int32_t)v.pdb1[d]}});
+      }
+      for (i64 d = 0; d < v.ndo; d++) {
+        if ((i64)cur->dels.size() >= MD) cur = next_sub();
+        i64 s0 = slot_of(v.pdo0[d]);
+        cur->dels.push_back(std::array<int32_t, 3>{{
+            1, (int32_t)s0, (int32_t)(s0 + (v.pdo1[d] - v.pdo0[d]))}});
+      }
+    } else {
+      return -1;  // unknown action kind
+    }
+  }
+  if (use_cache) {
+    // consumed: a long-lived ctx must not pin O(document) composed
+    // columns after the pack (the fetch path clears its own copy)
+    cx->composed.clear();
+    cx->composed.shrink_to_fit();
+  }
+  cx->pack_steps = std::move(ps.steps);
+  return (i64)cx->pack_steps.size();
+}
+
+// Fill the caller's [T]-and-[T,M]-shaped arrays INCLUDING the pad
+// cells (the caller allocates with np.empty — zero/pad-initializing
+// ~100 MB of tape in numpy costs more than writing it once here) and
+// free the buffer. Pads: blk_cursor/blk_prev/ch_slot/ch_ol_static/
+// del_kind -1, everything else 0.
+extern "C" void dt_zone_pack_fetch(
+    void* p, int32_t* op, int32_t* arg_a, int32_t* arg_b, int32_t* snap_flag,
+    int32_t* blk_cursor, int32_t* blk_prev, int32_t* blk_root,
+    int32_t* blk_start_o, int32_t* blk_len_o, int32_t* ch_slot,
+    int32_t* ch_ol_static, int32_t* ch_ol_coord, int32_t* ch_orr_own,
+    int32_t* ch_blk, int32_t* ch_agent, int32_t* ch_seq, int32_t* del_kind,
+    int32_t* del_a, int32_t* del_b, i64 MB, i64 MC, i64 MD) {
+  Ctx* c = (Ctx*)p;
+  i64 T = (i64)c->pack_steps.size();
+  i64 Tp = T > 0 ? T : 1;
+  std::memset(op, 0, (size_t)Tp * 4);
+  std::memset(arg_a, 0, (size_t)Tp * 4);
+  std::memset(arg_b, 0, (size_t)Tp * 4);
+  std::memset(snap_flag, 0, (size_t)Tp * 4);
+  std::memset(blk_cursor, 0xFF, (size_t)(Tp * MB) * 4);   // -1
+  std::memset(blk_prev, 0xFF, (size_t)(Tp * MB) * 4);     // -1
+  std::memset(blk_root, 0, (size_t)(Tp * MB) * 4);
+  std::memset(blk_start_o, 0, (size_t)(Tp * MB) * 4);
+  std::memset(blk_len_o, 0, (size_t)(Tp * MB) * 4);
+  std::memset(ch_slot, 0xFF, (size_t)(Tp * MC) * 4);      // -1
+  std::memset(ch_ol_static, 0xFF, (size_t)(Tp * MC) * 4); // -1
+  std::memset(ch_ol_coord, 0, (size_t)(Tp * MC) * 4);
+  std::memset(ch_orr_own, 0xFF, (size_t)(Tp * MC) * 4);   // -1
+  std::memset(ch_blk, 0, (size_t)(Tp * MC) * 4);
+  std::memset(ch_agent, 0, (size_t)(Tp * MC) * 4);
+  std::memset(ch_seq, 0, (size_t)(Tp * MC) * 4);
+  std::memset(del_kind, 0xFF, (size_t)(Tp * MD) * 4);     // -1
+  std::memset(del_a, 0, (size_t)(Tp * MD) * 4);
+  std::memset(del_b, 0, (size_t)(Tp * MD) * 4);
+  for (size_t t = 0; t < c->pack_steps.size(); t++) {
+    const zonepack::Step& s = c->pack_steps[t];
+    op[t] = s.op; arg_a[t] = s.a; arg_b[t] = s.b; snap_flag[t] = s.snap;
+    for (size_t i = 0; i < s.blocks.size(); i++) {
+      blk_cursor[t * MB + i] = s.blocks[i][0];
+      blk_prev[t * MB + i] = s.blocks[i][1];
+      blk_root[t * MB + i] = s.blocks[i][2];
+      blk_start_o[t * MB + i] = s.blocks[i][3];
+      blk_len_o[t * MB + i] = s.blocks[i][4];
+    }
+    for (size_t i = 0; i < s.chars.size(); i++) {
+      ch_slot[t * MC + i] = s.chars[i][0];
+      ch_ol_static[t * MC + i] = s.chars[i][1];
+      ch_ol_coord[t * MC + i] = s.chars[i][2];
+      ch_orr_own[t * MC + i] = s.chars[i][3];
+      ch_blk[t * MC + i] = s.chars[i][4];
+      ch_agent[t * MC + i] = s.chars[i][5];
+      ch_seq[t * MC + i] = s.chars[i][6];
+    }
+    for (size_t i = 0; i < s.dels.size(); i++) {
+      del_kind[t * MD + i] = s.dels[i][0];
+      del_a[t * MD + i] = s.dels[i][1];
+      del_b[t * MD + i] = s.dels[i][2];
+    }
+  }
+  c->pack_steps.clear();
+  c->pack_steps.shrink_to_fit();
 }
 
 // Linear fast-forward prefix composition (assemble_prefix's hot loop):
