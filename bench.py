@@ -841,7 +841,11 @@ def main() -> None:
         full["stats_error"] = str(e)[:200]
 
     try:
-        ff_ops, ff_t, ff_snap, _ = bench_merge("friendsforever.dt", repeats=3)
+        # best-of-9 as of r5 (was best-of-3 in r1-r4): at 2.4 ms/run the
+        # extra repeats are free and the small corpus is the most
+        # variance-sensitive merge row; recorded in BASELINE.md r5 notes
+        ff_ops, ff_t, ff_snap, _ = bench_merge("friendsforever.dt",
+                                               repeats=9)
         import gzip
         import json as _json
         with gzip.open(os.path.join(BENCH_DATA,
