@@ -176,6 +176,9 @@ def _configure(lib) -> None:
                                     ct.c_int64, _i64p, ct.c_int64]
     lib.dt_encode_patch.restype = ct.c_int64
     lib.dt_encode_fetch.argtypes = [ct.c_void_p, _u8p]
+    lib.dt_zone_ins_runs.argtypes = [ct.c_void_p, ct.c_int64, _i64p,
+                                     _i64p, _i64p, _i64p, _i64p]
+    lib.dt_zone_ins_runs.restype = ct.c_int64
     lib.dt_zone_pack.argtypes = [
         ct.c_void_p, ct.c_int64, _i64p, _i64p, _i64p,          # actions
         ct.c_int64, _i64p,                                      # counts
@@ -284,6 +287,29 @@ class NativeContext:
         dt_compose_plan) — the zone packer validates it before packing
         from the cache."""
         return int(self._lib.dt_compose_serial(self._ptr))
+
+    def zone_ins_runs(self, spans):
+        """INS sub-runs of the given spans as (lv0, len, cp) int64
+        arrays — prepare_zone's table pass in C++; None on unsupported
+        input (insert without stored content)."""
+        self.sync()
+        n = len(spans)
+        s0 = np.ascontiguousarray(
+            [s for s, _ in spans] or [0], dtype=np.int64)
+        s1 = np.ascontiguousarray(
+            [e for _, e in spans] or [0], dtype=np.int64)
+        # bounded by the zone's own extent, not the whole history: a
+        # span of L LVs overlaps at most L runs, and tiny incremental
+        # zones must not allocate O(total-history) receive buffers
+        span_lvs = sum(e - s for s, e in spans)
+        cap = min(len(self._oplog.ops.runs), span_lvs) + n + 1
+        lv0 = np.empty(cap, dtype=np.int64)
+        ln = np.empty(cap, dtype=np.int64)
+        cp = np.empty(cap, dtype=np.int64)
+        k = self._lib.dt_zone_ins_runs(self._ptr, n, s0, s1, lv0, ln, cp)
+        if k < 0:
+            return None
+        return lv0[:k], ln[:k], cp[:k]
 
     def compose_cache_only(self, spans) -> bool:
         """Run the native composer, leaving results ONLY in the ctx

@@ -3567,6 +3567,41 @@ extern "C" void dt_zone_pack_fetch(
   c->pack_steps.shrink_to_fit();
 }
 
+// Zone insert-run collection (prepare_zone's table pass — ~50k
+// Python piece iterations on node_nodecc): INS sub-runs of the given
+// (disjoint, ascending) spans as (lv0, len, arena cp) columns. Returns
+// the run count, or -1 when an insert lacks stored content. The caller
+// sizes the outputs at #op_runs + #spans (a span boundary can split a
+// run, adding at most one piece per span edge).
+extern "C" i64 dt_zone_ins_runs(void* p, i64 nspans, const i64* s0,
+                                const i64* s1, i64* lv0, i64* len_out,
+                                i64* cp_out) {
+  Ctx* c = (Ctx*)p;
+  i64 k = 0;
+  for (i64 i = 0; i < nspans; i++) {
+    i64 lo = s0[i], hi = s1[i];
+    if (hi <= lo) continue;
+    size_t oi = c->ops.find_idx(lo);
+    i64 pos = lo;
+    while (pos < hi) {
+      const OpRun& run = c->ops.runs[oi];
+      i64 run_end = run.lv + (run.end - run.start);
+      i64 o0 = pos - run.lv;
+      i64 o1 = std::min(hi, run_end) - run.lv;
+      if (run.kind == INS) {
+        if (run.cp < 0) return -1;  // zone insert without stored content
+        lv0[k] = run.lv + o0;
+        len_out[k] = o1 - o0;
+        cp_out[k] = run.cp + o0;
+        k++;
+      }
+      pos = run.lv + o1;
+      oi++;
+    }
+  }
+  return k;
+}
+
 // Linear fast-forward prefix composition (assemble_prefix's hot loop):
 // compose the (sorted, causally linear) spans over an EMPTY base and
 // return the alive own pieces in document order — the caller joins their
