@@ -39,6 +39,7 @@ static const i64 UNDERWATER = 1ll << 62;
 #ifdef DT_PROF
 static long g_diff_calls = 0, g_diff_iters = 0;
 long g_walk_steps = 0, g_walk_zero = 0, g_diff_iters2 = 0;
+long g_orr_iters = 0;
 #endif
 
 // Always-on structured event counters around the merge kernel (SURVEY §5:
@@ -1311,6 +1312,10 @@ struct Tracker {
       i64 origin_right = ROOT;
       if (roll(c2)) {
         while (true) {
+#ifdef DT_PROF
+          extern long g_orr_iters;
+          g_orr_iters++;
+#endif
           const BEntry& e = c2.leaf->e[c2.idx];
           if (e.state == 0) {
             if (!next_entry(c2)) { origin_right = ROOT; break; }
@@ -1548,9 +1553,11 @@ extern "C" void dt_prof_dump() {
           g_prof.apply_ins, g_prof.apply_del, g_prof.emit_misc, g_prof.doc,
           g_prof.conflict);
   fprintf(stderr,
-          "diff calls=%ld iters=%ld local_iters=%ld walk steps=%ld zero=%ld\n",
+          "diff calls=%ld iters=%ld local_iters=%ld walk steps=%ld "
+          "zero=%ld orr_iters=%ld\n",
           g_diff_calls, g_diff_iters, g_diff_iters2, g_walk_steps,
-          g_walk_zero);
+          g_walk_zero, g_orr_iters);
+  g_orr_iters = 0;
   g_diff_calls = g_diff_iters = g_diff_iters2 = g_walk_steps = g_walk_zero = 0;
   g_prof = ProfCounters{};
 }
