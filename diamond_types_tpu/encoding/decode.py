@@ -257,20 +257,47 @@ def _rebuild_from_native(oplog: OpLog, cols: dict) -> List[int]:
     assert ins_base == 0 and del_base == 0, "native decode needs fresh arenas"
     (olv, okind, ostart, oend, ofwd, oknown, oclen) = cols["ops"]
     runs = oplog.ops.runs
-    cpos = [0, 0]  # per-kind char cursor into the blobs
-    for i in range(len(olv)):
-        kind = int(okind[i])
-        if oknown[i]:
-            c0 = cpos[kind]
-            cp = (c0, c0 + int(oclen[i]))
-            cpos[kind] = cp[1]
-        else:
-            cp = None
-        runs.append(OpRun(int(olv[i]), kind, int(ostart[i]), int(oend[i]),
-                          bool(ofwd[i]), cp))
+    # vectorized arena-cursor math + bulk row conversion: the per-row
+    # Python loop was the decode hot spot on big corpora (~53k rows on
+    # node_nodecc)
+    import numpy as _np
+    known = _np.asarray(oknown, dtype=bool)
+    kind_arr = _np.asarray(okind, dtype=_np.int64)
+    clen = _np.asarray(oclen, dtype=_np.int64)
+    c0 = _np.zeros(len(olv), dtype=_np.int64)
+    for k in (INS, DEL):
+        sel = known & (kind_arr == k)
+        take = _np.where(sel, clen, 0)
+        c0 += _np.where(sel, _np.cumsum(take) - take, 0)
+    rows = zip(_np.asarray(olv).tolist(), kind_arr.tolist(),
+               _np.asarray(ostart).tolist(), _np.asarray(oend).tolist(),
+               _np.asarray(ofwd, dtype=bool).tolist(), known.tolist(),
+               c0.tolist(), clen.tolist())
+    for (lv_i, kind, st, en, fwd, kn, cc, cl) in rows:
+        runs.append(OpRun(lv_i, kind, st, en, fwd,
+                          (cc, cc + cl) if kn else None))
 
     g_start, g_end, g_off, g_par = cols["graph"]
     graph = oplog.cg.graph
+    from ..native.core import graph_rebuild_native
+    built = graph_rebuild_native(g_start, g_end, g_off, g_par)
+    if built is not None:
+        # batch path (same push/advance semantics, computed in C++ —
+        # pinned equal to the per-row path by tests/test_decode.py)
+        (ms, me, msh, pind, pflat, cind, cflat, croot, ver) = built
+        graph.starts = ms.tolist()
+        graph.ends = me.tolist()
+        graph.shadows = msh.tolist()
+        pf = pflat.tolist()
+        pi = pind.tolist()
+        graph.parents = [tuple(pf[pi[i]:pi[i + 1]])
+                         for i in range(len(ms))]
+        cf = cflat.tolist()
+        ci = cind.tolist()
+        graph.child_idxs = [cf[ci[i]:ci[i + 1]] for i in range(len(ms))]
+        graph.root_child_idxs = croot.tolist()
+        oplog.cg.version[:] = ver.tolist()
+        return list(oplog.cg.version)
     for i in range(len(g_start)):
         parents = [int(p) for p in g_par[g_off[i]:g_off[i + 1]]]
         span = (int(g_start[i]), int(g_end[i]))

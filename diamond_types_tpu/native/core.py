@@ -179,6 +179,8 @@ def _configure(lib) -> None:
     lib.dt_zone_ins_runs.argtypes = [ct.c_void_p, ct.c_int64, _i64p,
                                      _i64p, _i64p, _i64p, _i64p]
     lib.dt_zone_ins_runs.restype = ct.c_int64
+    lib.dt_graph_rebuild.argtypes = [ct.c_int64] + [_i64p] * 15
+    lib.dt_graph_rebuild.restype = ct.c_int64
     lib.dt_zone_pack.argtypes = [
         ct.c_void_p, ct.c_int64, _i64p, _i64p, _i64p,          # actions
         ct.c_int64, _i64p,                                      # counts
@@ -687,6 +689,40 @@ def get_native_ctx(oplog) -> "NativeContext":
         ctx = NativeContext(oplog)
         oplog._native_ctx = ctx
     return ctx
+
+
+def graph_rebuild_native(g_start, g_end, g_off, g_par):
+    """Batch-apply graph.py push + _advance_known_run semantics to the
+    decoder's graph rows in C++: (starts, ends, shadows, parents CSR,
+    children CSR, roots, version) or None when native is unavailable or
+    the rows are malformed (caller falls back to per-row push)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(g_start)
+    npar = len(g_par)
+    a = lambda x: np.ascontiguousarray(x, dtype=np.int64)  # noqa: E731
+    one = np.zeros(1, np.int64)
+    ms = np.empty(max(n, 1), np.int64)
+    me = np.empty(max(n, 1), np.int64)
+    msh = np.empty(max(n, 1), np.int64)
+    pind = np.empty(n + 1, np.int64)
+    pflat = np.empty(max(npar, 1), np.int64)
+    cind = np.empty(n + 1, np.int64)
+    cflat = np.empty(max(npar, 1), np.int64)
+    croot = np.empty(max(n, 1), np.int64)
+    crn = np.zeros(1, np.int64)
+    ver = np.empty(max(n, 1), np.int64)
+    vern = np.zeros(1, np.int64)
+    m = lib.dt_graph_rebuild(
+        n, a(g_start), a(g_end), a(g_off), a(g_par) if npar else one,
+        ms, me, msh, pind, pflat, cind, cflat, croot, crn, ver, vern)
+    if m < 0:
+        return None
+    k = int(m)
+    return (ms[:k], me[:k], msh[:k], pind[:k + 1], pflat[:int(pind[k])],
+            cind[:k + 1], cflat[:int(cind[k])], croot[:int(crn[0])],
+            ver[:int(vern[0])])
 
 
 def content_columns(oplog):

@@ -21,7 +21,7 @@ INS = 0
 DEL = 1
 
 
-@dataclass
+@dataclass(slots=True)
 class OpRun:
     lv: int              # starting LV of this run
     kind: int            # INS / DEL

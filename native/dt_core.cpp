@@ -3574,6 +3574,109 @@ extern "C" void dt_zone_pack_fetch(
   c->pack_steps.shrink_to_fit();
 }
 
+// Graph rebuild from decoded rows (decode.py _rebuild_from_native's hot
+// loop): RLE-merge linear rows, compute shadows, sort parents, and emit
+// the version frontier — the exact incremental semantics of
+// causalgraph/graph.py::push + _advance_known_run, batch-applied.
+// Outputs (caller-allocated at n / len(par) upper bounds): merged
+// starts/ends/shadows, parent CSR (pindptr[m+1], pflat), child CSR
+// (cindptr[m+1], cflat, croot with its count in croot_n[0]), version
+// (ascending; count in ver_n[0]). Returns the merged run count m.
+extern "C" i64 dt_graph_rebuild(i64 n, const i64* start, const i64* end,
+                                const i64* off, const i64* par,
+                                i64* m_starts, i64* m_ends, i64* m_shadows,
+                                i64* m_pindptr, i64* m_pflat,
+                                i64* m_cindptr, i64* m_cflat, i64* m_croot,
+                                i64* croot_n, i64* ver_out, i64* ver_n) {
+  i64 m = 0;
+  i64 pk = 0;
+  m_pindptr[0] = 0;
+  std::vector<i64> psort;
+  auto find_idx = [&](i64 v) -> i64 {
+    // binary search over the merged runs built so far
+    i64 lo = 0, hi = m;
+    while (lo < hi) {
+      i64 mid = (lo + hi) / 2;
+      if (v < m_starts[mid]) hi = mid;
+      else if (v >= m_ends[mid]) lo = mid + 1;
+      else return mid;
+    }
+    return -1;
+  };
+  for (i64 i = 0; i < n; i++) {
+    i64 np = off[i + 1] - off[i];
+    const i64* ps = par + off[i];
+    // parents must reference EARLIER LVs: the per-row Python path
+    // rejects forward references loudly (find_idx KeyError), and a
+    // batch path that resolved them after the fact would install a
+    // silently-corrupt graph
+    for (i64 k = 0; k < np; k++)
+      if (ps[k] >= start[i]) return -1;
+    // RLE extend: linear continuation of the previous run
+    if (m > 0 && np == 1 && ps[0] == m_ends[m - 1] - 1 &&
+        m_ends[m - 1] == start[i]) {
+      m_ends[m - 1] = end[i];
+      continue;
+    }
+    // shadow walk (graph.py push)
+    i64 shadow = start[i];
+    bool moved = true;
+    while (moved && shadow >= 1) {
+      moved = false;
+      for (i64 k = 0; k < np; k++) {
+        if (ps[k] == shadow - 1) {
+          i64 j = find_idx(shadow - 1);
+          if (j < 0) return -1;  // corrupt rows: caller falls back
+          shadow = m_shadows[j];
+          moved = true;
+          break;
+        }
+      }
+    }
+    m_starts[m] = start[i];
+    m_ends[m] = end[i];
+    m_shadows[m] = shadow;
+    psort.assign(ps, ps + np);
+    std::sort(psort.begin(), psort.end());
+    for (i64 v : psort) m_pflat[pk++] = v;
+    m_pindptr[m + 1] = pk;
+    m++;
+  }
+  // child CSR + roots
+  std::fill(m_cindptr, m_cindptr + m + 1, 0);
+  i64 nroot = 0;
+  for (i64 i = 0; i < m; i++) {
+    i64 np = m_pindptr[i + 1] - m_pindptr[i];
+    if (np == 0) m_croot[nroot++] = i;
+    for (i64 k = m_pindptr[i]; k < m_pindptr[i + 1]; k++) {
+      i64 j = find_idx(m_pflat[k]);
+      if (j < 0) return -1;  // corrupt rows: caller falls back
+      m_cindptr[j + 1]++;
+    }
+  }
+  croot_n[0] = nroot;
+  for (i64 i = 0; i < m; i++) m_cindptr[i + 1] += m_cindptr[i];
+  {
+    std::vector<i64> fill(m_cindptr, m_cindptr + m);
+    for (i64 i = 0; i < m; i++)
+      for (i64 k = m_pindptr[i]; k < m_pindptr[i + 1]; k++)
+        m_cflat[fill[(size_t)find_idx(m_pflat[k])]++] = i;
+  }
+  // version frontier: entry-final LVs never referenced as a parent
+  {
+    std::vector<i64> allp(m_pflat, m_pflat + pk);
+    std::sort(allp.begin(), allp.end());
+    i64 kv = 0;
+    for (i64 i = 0; i < m; i++) {
+      i64 last = m_ends[i] - 1;
+      if (!std::binary_search(allp.begin(), allp.end(), last))
+        ver_out[kv++] = last;
+    }
+    ver_n[0] = kv;
+  }
+  return m;
+}
+
 // Zone insert-run collection (prepare_zone's table pass — ~50k
 // Python piece iterations on node_nodecc): INS sub-runs of the given
 // (disjoint, ascending) spans as (lv0, len, arena cp) columns. Returns

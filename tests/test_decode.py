@@ -70,6 +70,12 @@ def test_native_decoder_tables_identical_to_python():
         assert a.cg.graph.starts == b.cg.graph.starts
         assert a.cg.graph.ends == b.cg.graph.ends
         assert a.cg.graph.parents == b.cg.graph.parents
+        # the batch graph rebuild computes these three too — pin them
+        # (a shadow/child regression would otherwise surface only as
+        # wrong diff/dominator results much later)
+        assert a.cg.graph.shadows == b.cg.graph.shadows
+        assert a.cg.graph.child_idxs == b.cg.graph.child_idxs
+        assert a.cg.graph.root_child_idxs == b.cg.graph.root_child_idxs
         assert a.cg.agent_assignment.global_runs == \
             b.cg.agent_assignment.global_runs
         assert a.cg.agent_assignment.agent_names == \
