@@ -631,22 +631,140 @@ def _flush_partial(full: dict, out: dict) -> None:
         pass
 
 
-def _run_device_phase(full: dict) -> dict:
+# Every device bench _run_device_phase runs, in its summary-key naming
+# (error keys are exactly f"{name}_error"). device_watcher.py imports
+# this to classify banked keys — keep it in sync with the guarded()
+# calls below. Ordering: longest prefix first (pallas before its base)
+# so prefix classification is unambiguous.
+DEVICE_BENCHES = (
+    "tpu_merge_git_makefile_pallas",
+    "tpu_merge_git_makefile",
+    "tpu_merge_friendsforever",
+    "tpu_merge_node_nodecc_sweep",
+    "tpu_zone_git_makefile",
+    "tpu_zone_friendsforever",
+    "tpu_session_friendsforever",
+    "tpu_batched_replay",
+    "fanin_10k",
+)
+
+
+DEVICE_LOCK = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".device_lock")
+
+
+def _pid_alive(pid: int) -> bool:
+    """Signal-0 liveness probe (shared with device_watcher.py's
+    single-instance guard)."""
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True           # exists, owned by another user
+    except (OSError, ValueError):
+        return False
+
+
+def _acquire_device_lock(timeout_s: int = 7200) -> None:
+    """Mutual exclusion between concurrent device phases (bench.py main
+    vs device_watcher.py): two processes driving the tunneled chip at
+    once would bill each other's contention as kernel time. Blocks while
+    a LIVE holder exists, up to timeout_s — after that we proceed anyway
+    (the round-end bench run must never be starved by a hung watcher);
+    a dead holder's lock is stolen immediately. The default exceeds the
+    worst-case phase duration (sum of per-bench subprocess timeouts
+    ~74 min, plus in-lock probe and per-bench wedge retries), so a
+    healthy long-running phase is never stolen from."""
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            fd = os.open(DEVICE_LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return
+        except FileExistsError:
+            try:
+                holder = int(open(DEVICE_LOCK).read().strip() or "0")
+            except (OSError, ValueError):
+                holder = 0
+            alive = False
+            if holder and holder != os.getpid():
+                alive = _pid_alive(holder)
+            elif holder == 0:
+                # empty/garbled file: may be a holder between its
+                # O_EXCL create and pid write — only treat as dead
+                # once the file is old enough that that window is over
+                try:
+                    alive = (time.time()
+                             - os.path.getmtime(DEVICE_LOCK)) < 60
+                except OSError:
+                    alive = False      # vanished: retry the create
+            if not alive or time.time() > deadline:
+                # steal via rename-aside: only ONE of several waiters
+                # can win the rename of a given lock inode, so a
+                # concurrent stealer can't blind-remove the winner's
+                # freshly re-created lock
+                steal = f"{DEVICE_LOCK}.steal.{os.getpid()}"
+                try:
+                    os.rename(DEVICE_LOCK, steal)
+                    # re-validate post-rename: if the renamed file no
+                    # longer holds the pid we judged dead, we raced a
+                    # faster stealer's re-created LIVE lock — restore it
+                    try:
+                        now = int(open(steal).read().strip() or "0")
+                    except (OSError, ValueError):
+                        now = holder
+                    if now != holder and now and _pid_alive(now):
+                        os.rename(steal, DEVICE_LOCK)
+                    else:
+                        os.remove(steal)
+                except OSError:
+                    pass          # another waiter won; re-evaluate
+                continue
+            time.sleep(10)
+
+
+def _release_device_lock() -> None:
+    try:
+        # release only our own lock: after a deadline steal the old
+        # holder's release must not delete the stealer's lock
+        if int(open(DEVICE_LOCK).read().strip() or "0") == os.getpid():
+            os.remove(DEVICE_LOCK)
+    except (OSError, ValueError):
+        pass
+
+
+def _run_device_phase(full: dict, probe: dict = None,
+                      skip: frozenset = frozenset()) -> dict:
     """All device benches, probe-gated, wedge-bounded. Returns a dict of
-    summary-line entries (scalars + short error strings)."""
+    summary-line entries (scalars + short error strings). A caller that
+    just probed (device_watcher.py) passes its result in to skip the
+    second probe round-trip; `skip` names benches already banked this
+    round, so a short recovery window is spent on the missing ones (the
+    skip entries come back as short `_error` strings, which the
+    watcher's bank merge ignores in favor of the banked ok data)."""
+    t0 = time.time()
+    _acquire_device_lock()
+    try:
+        if probe is not None and time.time() - t0 > 120:
+            probe = None   # stale after a long lock wait: re-probe
+        return _run_device_phase_locked(full, probe, skip)
+    finally:
+        _release_device_lock()
+
+
+def _run_device_phase_locked(full: dict, probe: dict,
+                             skip: frozenset = frozenset()) -> dict:
     out = {}
-    probe = device_probe()
+    if probe is None:
+        probe = device_probe()
     full["device_probe"] = probe
     _flush_partial(full, out)
     if not probe.get("ok"):
         attempts = "twice" if probe.get("retried") else "once (no retry: " \
             "failure signature is not a wedge)"
         msg = f"device probe failed {attempts}: " + _short_err(probe)
-        for k in ("tpu_batched_replay", "fanin_10k", "tpu_merge_git_makefile",
-                  "tpu_merge_friendsforever", "tpu_merge_node_nodecc_sweep",
-                  "tpu_zone_git_makefile", "tpu_zone_friendsforever",
-                  "tpu_merge_git_makefile_pallas",
-                  "tpu_session_friendsforever"):
+        for k in DEVICE_BENCHES:
             out[f"{k}_error"] = msg
         _flush_partial(full, out)
         return out
@@ -663,6 +781,10 @@ def _run_device_phase(full: dict) -> dict:
         # caller adds them to `out` after guarded returns); the phase-end
         # flush covers the last bench
         _flush_partial(full, out)
+        if name in skip:
+            full[name] = {"ok": False,
+                          "why": "skipped: already banked this round"}
+            return full[name]
         if consecutive_wedges >= 2:
             full[name] = {"ok": False, "why": "skipped: tunnel wedged "
                           "(2 consecutive device benches failed)"}
