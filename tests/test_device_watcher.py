@@ -1,0 +1,225 @@
+"""Unit tests for the tunnel-recovery watcher's banking logic
+(device_watcher.py) and the device-phase lock in bench.py.
+
+The watcher exists to bank on-chip bench results in any window the
+tunneled TPU allows (VERDICT r4 next-step #2); these tests pin the
+invariants that make a catch durable: ok results are never clobbered
+by later errors/skips, completeness is judged per-bench, and the lock
+protocol can't lose mutual exclusion to a dead holder's leftovers.
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def dw(tmp_path_factory):
+    spec = importlib.util.spec_from_file_location(
+        "device_watcher", os.path.join(REPO, "device_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def bank_paths(dw, tmp_path, monkeypatch):
+    monkeypatch.setattr(dw, "BANK", str(tmp_path / "bank.json"))
+    monkeypatch.setattr(dw, "RUN_SCRATCH", str(tmp_path / "run.json"))
+    return dw
+
+
+def test_bench_list_is_shared_with_bench_py(dw):
+    import bench
+    assert dw.BENCHES is bench.DEVICE_BENCHES
+    assert len(dw.BENCHES) == 9
+
+
+def test_bench_of_classifies_real_phase_keys(dw):
+    # exact key names bench._run_device_phase emits on success
+    cases = {
+        "tpu_merge_git_makefile_ops_per_sec": "tpu_merge_git_makefile",
+        "tpu_merge_git_makefile_prep_ms": "tpu_merge_git_makefile",
+        "tpu_merge_git_makefile_docs_per_call": "tpu_merge_git_makefile",
+        "tpu_merge_git_makefile_pallas_ops_per_sec":
+            "tpu_merge_git_makefile_pallas",
+        "tpu_merge_git_makefile_pallas_per_call_ms":
+            "tpu_merge_git_makefile_pallas",
+        "tpu_zone_git_makefile_ops_per_sec": "tpu_zone_git_makefile",
+        "tpu_zone_friendsforever_prep_ms": "tpu_zone_friendsforever",
+        "tpu_merge_friendsforever_per_call_ms": "tpu_merge_friendsforever",
+        "tpu_merge_node_nodecc_best_ops_per_sec":
+            "tpu_merge_node_nodecc_sweep",
+        "tpu_merge_node_nodecc_best_chunk": "tpu_merge_node_nodecc_sweep",
+        "tpu_merge_batch_sweep": "tpu_merge_node_nodecc_sweep",
+        "tpu_session_per_merge_ms": "tpu_session_friendsforever",
+        "tpu_session_batch32_ms": "tpu_session_friendsforever",
+        "tpu_session_build_ms": "tpu_session_friendsforever",
+        "tpu_batched_replay_ops_per_sec": "tpu_batched_replay",
+        "fanin_10k_propagation_ms": "fanin_10k",
+        # globals
+        "device_platform": None,
+        "tunnel_rtt_ms": None,
+    }
+    for key, bench_name in cases.items():
+        assert dw._bench_of(key) == bench_name, key
+    # every bench's error key maps back to it
+    for b in dw.BENCHES:
+        assert dw._bench_of(f"{b}_error") == b
+
+
+def test_merge_never_downgrades_ok_data(dw):
+    run1 = {"tpu_session_per_merge_ms": 4.3,
+            "tpu_merge_node_nodecc_best_ops_per_sec": 9e6,
+            "tpu_merge_git_makefile_ops_per_sec": 6e6,
+            "fanin_10k_error": "wedge"}
+    run2 = {"tpu_session_friendsforever_error": "wedge",
+            "tpu_merge_node_nodecc_sweep_error": "wedge",
+            "tpu_merge_git_makefile_error": "wedge",
+            "tpu_merge_git_makefile_pallas_ops_per_sec": 3e6,
+            "fanin_10k_propagation_ms": 67.0}
+    m = dw._merge_summary(dw._merge_summary({}, run1), run2)
+    # earlier oks survive later errors (including non-prefix key benches)
+    assert m["tpu_session_per_merge_ms"] == 4.3
+    assert "tpu_session_friendsforever_error" not in m
+    assert m["tpu_merge_node_nodecc_best_ops_per_sec"] == 9e6
+    assert m["tpu_merge_git_makefile_ops_per_sec"] == 6e6
+    assert "tpu_merge_git_makefile_error" not in m
+    # later ok evicts earlier error; pallas does not mask its base bench
+    assert m["fanin_10k_propagation_ms"] == 67.0
+    assert "fanin_10k_error" not in m
+    assert m["tpu_merge_git_makefile_pallas_ops_per_sec"] == 3e6
+
+
+def test_merge_discards_skip_errors(dw):
+    banked = {"tpu_batched_replay_ops_per_sec": 1e6}
+    m = dw._merge_summary(
+        banked, {"tpu_batched_replay_error":
+                 "skipped: already banked this round"})
+    assert m == banked
+
+
+def test_catch_complete_requires_every_bench(dw):
+    partial = {"tpu_merge_git_makefile_ops_per_sec": 1.0,
+               "fanin_10k_propagation_ms": 1.0}
+    assert not dw._catch_complete(partial)
+    # real ok-key spellings, one per bench
+    done = {"tpu_merge_git_makefile_ops_per_sec": 1,
+            "tpu_merge_git_makefile_pallas_ops_per_sec": 1,
+            "tpu_merge_friendsforever_ops_per_sec": 1,
+            "tpu_merge_node_nodecc_best_ops_per_sec": 1,
+            "tpu_zone_git_makefile_ops_per_sec": 1,
+            "tpu_zone_friendsforever_ops_per_sec": 1,
+            "tpu_session_per_merge_ms": 1,
+            "tpu_batched_replay_ops_per_sec": 1,
+            "fanin_10k_propagation_ms": 1}
+    assert dw._catch_complete(done)
+    assert not dw._catch_complete({})
+
+
+def test_bank_run_bounds_history_and_full_reports(bank_paths):
+    dw = bank_paths
+    m = dw._bank_run("t1", {"tpu_merge_git_makefile_ops_per_sec": 1e6,
+                            "fanin_10k_error": "w"}, {"detail": 1})
+    assert m["tpu_merge_git_makefile_ops_per_sec"] == 1e6
+    # error-only run (globals present) stores no full report
+    dw._bank_run("t2", {"device_platform": "tpu", "tunnel_rtt_ms": 9.0,
+                        "fanin_10k_error": "w"}, {"big": "tail"})
+    bank = json.load(open(dw.BANK))
+    assert "full" in bank["runs"][0]
+    assert "full" not in bank["runs"][1]
+    for i in range(20):
+        dw._bank_run(f"x{i}", {"fanin_10k_error": "w"}, {})
+    assert len(json.load(open(dw.BANK))["runs"]) == 12
+    # banked ok survives all those error runs
+    assert json.load(open(dw.BANK))["summary"][
+        "tpu_merge_git_makefile_ops_per_sec"] == 1e6
+
+
+def test_bank_run_crash_fallback_reads_scratch(bank_paths):
+    dw = bank_paths
+    with open(dw.RUN_SCRATCH, "w") as f:
+        json.dump({"summary": {"fanin_10k_propagation_ms": 5.0},
+                   "full": {}}, f)
+    m = dw._bank_run("crash", None, None)
+    assert m["fanin_10k_propagation_ms"] == 5.0
+
+
+@pytest.fixture()
+def lockdir(tmp_path, monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, "DEVICE_LOCK", str(tmp_path / "lock"))
+    return bench
+
+
+def test_device_lock_roundtrip(lockdir):
+    bench = lockdir
+    bench._acquire_device_lock(timeout_s=5)
+    assert int(open(bench.DEVICE_LOCK).read()) == os.getpid()
+    bench._release_device_lock()
+    assert not os.path.exists(bench.DEVICE_LOCK)
+
+
+def test_device_lock_steals_dead_holder_fast(lockdir):
+    bench = lockdir
+    # a guaranteed-dead pid: fork a child that exits immediately, reap it
+    pid = os.fork()
+    if pid == 0:
+        os._exit(0)
+    os.waitpid(pid, 0)
+    with open(bench.DEVICE_LOCK, "w") as f:
+        f.write(str(pid))
+    t0 = time.time()
+    bench._acquire_device_lock(timeout_s=30)
+    assert time.time() - t0 < 5
+    bench._release_device_lock()
+
+
+def test_device_lock_respects_live_holder(lockdir):
+    bench = lockdir
+    # a FOREIGN live pid (holder == own pid is treated as self/dead):
+    # pid 1 is always alive
+    with open(bench.DEVICE_LOCK, "w") as f:
+        f.write("1")
+    released = threading.Event()
+
+    def free():
+        time.sleep(2)
+        os.remove(bench.DEVICE_LOCK)
+        released.set()
+
+    threading.Thread(target=free, daemon=True).start()
+    t0 = time.time()
+    bench._acquire_device_lock(timeout_s=60)
+    assert released.is_set() and time.time() - t0 >= 1.5
+    bench._release_device_lock()
+
+
+def test_release_leaves_foreign_lock(lockdir):
+    bench = lockdir
+    with open(bench.DEVICE_LOCK, "w") as f:
+        f.write("424242")
+    bench._release_device_lock()
+    assert os.path.exists(bench.DEVICE_LOCK)
+
+
+def test_phase_skip_runs_no_subprocess(lockdir):
+    """With every bench skipped and a caller-supplied ok probe, the phase
+    must return instantly with 9 short skip errors and no device work."""
+    bench = lockdir
+    full = {}
+    t0 = time.time()
+    out = bench._run_device_phase(
+        full, probe={"ok": True, "platform": "cpu", "rtt_ms": 1.0},
+        skip=frozenset(bench.DEVICE_BENCHES))
+    assert time.time() - t0 < 2.0
+    errs = {k: v for k, v in out.items() if k.endswith("_error")}
+    assert len(errs) == len(bench.DEVICE_BENCHES)
+    assert all("already banked" in v for v in errs.values())
+    assert out["device_platform"] == "cpu"
+    assert not os.path.exists(bench.DEVICE_LOCK)
