@@ -668,6 +668,21 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _pid_is(pid: int, needle: bytes) -> bool:
+    """True if `pid` is alive AND its cmdline contains `needle` — the
+    shared pid-reuse guard (a dead pid recycled by an unrelated process
+    must not read as a live holder). Unreadable /proc (another uid) is
+    conservatively treated as a match. Used by bench_is_active and
+    device_watcher.py's single-instance guard."""
+    if not pid or not _pid_alive(pid):
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return needle in f.read()
+    except OSError:
+        return True
+
+
 def _acquire_device_lock(timeout_s: int = 7200) -> None:
     """Mutual exclusion between concurrent device phases (bench.py main
     vs device_watcher.py): two processes driving the tunneled chip at
@@ -909,7 +924,41 @@ def _compact_extra(extra: dict) -> dict:
     return extra
 
 
+BENCH_ACTIVE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_active")
+
+
+def bench_is_active() -> bool:
+    """True while a bench.py main() run is in flight (live holder pid in
+    .bench_active). Background campaigns (device_watcher.py, tools/soak.py)
+    poll this and pause so they cannot contaminate official timings —
+    ambient CPU load swings host numbers ±20% on this machine and a
+    wedged-tunnel probe subprocess burns a core for ~90 s."""
+    try:
+        pid = int(open(BENCH_ACTIVE).read().strip() or "0")
+    except (OSError, ValueError):
+        return False
+    # _pid_is guards against a SIGKILLed run's stale pidfile + pid reuse
+    return _pid_is(pid, b"bench")
+
+
 def main() -> None:
+    with open(BENCH_ACTIVE, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        _main()
+    finally:
+        try:
+            # remove only our own marker: a second bench invocation that
+            # overwrote the pidfile and finished first must not drop the
+            # guard for a run still in flight
+            if int(open(BENCH_ACTIVE).read().strip() or "0") == os.getpid():
+                os.remove(BENCH_ACTIVE)
+        except (OSError, ValueError):
+            pass
+
+
+def _main() -> None:
     from diamond_types_tpu.native.core import (native_counters,
                                                reset_native_counters)
     from diamond_types_tpu.utils.stats import oplog_stats

@@ -153,23 +153,24 @@ def _bank_run(run_label: str, summary: dict = None,
 _pid_alive = bench._pid_alive
 
 
+def _sleep_cycle() -> None:
+    """Wait out one probe interval, reacting to the stop file within
+    seconds (shared by the skip branch and the end-of-cycle wait)."""
+    deadline = time.time() + PROBE_INTERVAL_S
+    while time.time() < deadline and not os.path.exists(STOP):
+        time.sleep(10)
+
+
 def main() -> None:
     # single-instance guard: two watchers would race the bank's
     # read-modify-write and could lose a banked catch
     try:
         other = int(open(PIDFILE).read().strip())
-        if other != os.getpid() and _pid_alive(other):
-            # guard against PID reuse: only defer to a process that is
-            # actually a watcher (cmdline check; unreadable /proc —
-            # e.g. another uid — is conservatively treated as one)
-            try:
-                with open(f"/proc/{other}/cmdline", "rb") as f:
-                    is_watcher = b"device_watcher" in f.read()
-            except OSError:
-                is_watcher = True
-            if is_watcher:
-                print(f"watcher already running (pid {other}); exiting")
-                return
+        # bench._pid_is guards against PID reuse: only defer to a live
+        # process that is actually a watcher
+        if other != os.getpid() and bench._pid_is(other, b"device_watcher"):
+            print(f"watcher already running (pid {other}); exiting")
+            return
     except (OSError, ValueError):
         pass
     with open(PIDFILE, "w") as f:
@@ -182,28 +183,42 @@ def main() -> None:
     _log({"event": "watcher_start", "pid": os.getpid(),
           "interval_s": PROBE_INTERVAL_S})
     while not os.path.exists(STOP):
-        # if another process (bench.py main) holds the device lock, its
-        # phase is mid-flight — even our cheap probe would add tunnel
-        # traffic to its timings; sit this cycle out
+        # sit a cycle out while an official bench run is in flight (its
+        # host phase would bill our probe subprocess's CPU as slowdown)
+        # or while another process holds the device lock mid-phase
         try:
             holder = int(open(bench.DEVICE_LOCK).read().strip() or "0")
         except (OSError, ValueError):
             holder = 0
-        if holder and holder != os.getpid() and _pid_alive(holder):
+        bench_active = bench.bench_is_active()
+        if bench_active or \
+                (holder and holder != os.getpid() and _pid_alive(holder)):
             _log({"event": "probe_skipped",
-                  "why": f"device lock held by pid {holder}"})
-            deadline = time.time() + PROBE_INTERVAL_S
-            while time.time() < deadline and not os.path.exists(STOP):
-                time.sleep(10)
+                  "why": "bench.py run in flight" if bench_active
+                         else f"device lock held by pid {holder}"})
+            _sleep_cycle()
             continue
         t0 = time.time()
-        probe = bench.device_probe()
+        # probe under the device lock: the probe itself drives the
+        # tunnel, so it must not land mid-bench of another process's
+        # device phase (released before the phase, which re-acquires)
+        bench._acquire_device_lock()
+        try:
+            probe = bench.device_probe()
+        finally:
+            bench._release_device_lock()
         _log({"event": "probe", "ok": bool(probe.get("ok")),
               "why": probe.get("why"), "rtt_ms": probe.get("rtt_ms"),
               "platform": probe.get("platform"),
               "probe_s": round(time.time() - t0, 1)})
         banked = _read_json(BANK).get("summary", {})
-        if probe.get("ok") and not _catch_complete(banked):
+        if probe.get("ok") and bench.bench_is_active():
+            # an official run started during our probe; its device phase
+            # will bank this window's evidence itself — stand down so
+            # our multi-minute phase can't overlap its host timings
+            _log({"event": "phase_skipped",
+                  "why": "bench.py started during probe"})
+        elif probe.get("ok") and not _catch_complete(banked):
             _log({"event": "phase_start"})
             os.environ["DT_DEVICE_PARTIAL_PATH"] = RUN_SCRATCH
             try:
@@ -235,9 +250,7 @@ def main() -> None:
                 except Exception as e:  # pragma: no cover — the watcher
                     # must keep probing even if banking itself fails
                     _log({"event": "bank_fail", "error": repr(e)[:300]})
-        deadline = time.time() + PROBE_INTERVAL_S
-        while time.time() < deadline and not os.path.exists(STOP):
-            time.sleep(10)
+        _sleep_cycle()
     _log({"event": "watcher_stop"})
     try:
         os.remove(PIDFILE)   # a dead pid must not lock out a relaunch
