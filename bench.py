@@ -93,6 +93,14 @@ def _run_device_bench(code: str, timeout: int):
     except subprocess.TimeoutExpired as e:
         stdout = (e.stdout or b"").decode("utf-8", "replace") \
             if isinstance(e.stdout, bytes) else (e.stdout or "")
+        # Long benches (the batch sweep) print cumulative RESULT/JSONDATA
+        # lines per stage: a timeout banks whatever stages completed
+        # instead of discarding a 15-minute run.
+        partial = _parse_bench_stdout(stdout)
+        if partial.get("ok"):
+            partial["partial_timeout"] = f"timed out after {timeout}s; " \
+                "result covers completed stages only"
+            return partial
         phase = "after device init" if "PLATFORM" in stdout \
             else "during jax/device init"
         return {"ok": False, "why": f"timeout after {timeout}s {phase}",
@@ -101,7 +109,6 @@ def _run_device_bench(code: str, timeout: int):
     except OSError as e:
         return {"ok": False, "why": f"spawn failed: {e}"}
 
-    out = {}
     if "DEVICE_UNRESPONSIVE" in stdout:
         return {"ok": False,
                 "why": f"device unresponsive (liveness probe timed out "
@@ -111,6 +118,25 @@ def _run_device_bench(code: str, timeout: int):
                 "platform": next((ln.split(None, 1)[1] for ln in
                                   stdout.splitlines()
                                   if ln.startswith("PLATFORM ")), "?")}
+    out = _parse_bench_stdout(stdout)
+    if out.get("ok"):
+        if rc != 0:
+            # cumulative-progress snippets can crash after printing valid
+            # stage results: keep the data, but carry the crash so the
+            # caller/bank can distinguish this from a completed run
+            out["partial_crash"] = f"exit {rc}: " + (
+                stderr.strip().splitlines()[-1][:160]
+                if stderr.strip() else "no stderr")
+        return out
+    tail = stderr.strip().splitlines()[-1][:200] if stderr.strip() else ""
+    return {"ok": False, "why": f"exit {rc}", "tail": tail, **out}
+
+
+def _parse_bench_stdout(stdout: str) -> dict:
+    """Parse a bench snippet's stdout protocol. Repeated RESULT/JSONDATA
+    lines overwrite (snippets print cumulative progress so partial runs
+    are parseable)."""
+    out = {}
     for line in stdout.splitlines():
         if line.startswith("RESULT "):
             out["ok"] = True
@@ -131,10 +157,7 @@ def _run_device_bench(code: str, timeout: int):
                     out[parts[0].lower()] = float(parts[1])
                 except ValueError:
                     pass
-    if out.get("ok"):
-        return out
-    tail = stderr.strip().splitlines()[-1][:200] if stderr.strip() else ""
-    return {"ok": False, "why": f"exit {rc}", "tail": tail, **out}
+    return out
 
 
 def _is_wedge(r: dict) -> bool:
@@ -453,32 +476,44 @@ for chunk in {chunks}:
                      for x in base)
         fn = _jitted_kernel(cap)
         texts, totals = fn(*args)
-        texts_np, totals_np = np.asarray(texts), np.asarray(totals)
-        for i in range(chunk):
-            got = texts_np[i][:int(totals_np[i])].astype(np.int32)\\
+        # Validate every replica at small chunks; at large chunks the
+        # vmapped kernel computes identical rows, and fetching the full
+        # [chunk, cap] text batch over the tunnel (0.5 GB at 1024) costs
+        # more than the bench itself — sample rows and fetch ONLY those.
+        rows = list(range(chunk)) if chunk <= 8 else \
+            sorted({{0, 1, chunk // 2, chunk - 1}})
+        sel = jnp.asarray(rows)
+        texts_np = np.asarray(texts[sel])
+        totals_np = np.asarray(totals[sel])
+        for k, i in enumerate(rows):
+            got = texts_np[k][:int(totals_np[k])].astype(np.int32)\\
                 .tobytes().decode('utf-32-le')
             assert got == expected, \\
                 'device merge diverged from host (replica %d)' % i
         dt = bench_call(lambda: fn(*args), lambda r: r[1], reps=3)
         ops_s = chunk * n_ops / dt
         curve[str(chunk)] = {{"per_call_ms": round(dt * 1e3, 2),
-                              "ops_per_sec": round(ops_s)}}
+                              "ops_per_sec": round(ops_s),
+                              "validated_rows": len(rows)}}
         if best is None or ops_s > best[1]:
             best = (chunk, ops_s, dt)
-        print("SWEEPDONE", chunk, flush=True)
     except Exception as e:
         curve[str(chunk)] = {{"error": str(e)[:120]}}
-print("JSONDATA", json.dumps({{"sweep": curve}}))
+    # cumulative progress: a timeout on a later chunk must not discard
+    # the completed points (bench.py parses the LAST of each line kind;
+    # flush so a timeout-kill can't drop a buffered error-only curve)
+    print("JSONDATA", json.dumps({{"sweep": curve}}), flush=True)
+    if best is not None:
+        print("BEST_CHUNK", best[0])
+        print("PER_CALL_MS", round(best[2] * 1e3, 2))
+        print("RESULT", best[1], flush=True)
 if best is None:
     raise SystemExit("no sweep point succeeded: " + json.dumps(curve))
-print("BEST_CHUNK", best[0])
-print("PER_CALL_MS", round(best[2] * 1e3, 2))
-print("RESULT", best[1])
 """
 
 
 def bench_device_merge_sweep(corpus: str = "node_nodecc.dt",
-                             chunks=(8, 64, 256, 1024), timeout: int = 900):
+                             chunks=(8, 64, 256, 1024), timeout: int = 1500):
     """Batch-amortization sweep (BASELINE config 4 at its written scale):
     device merge of `corpus` replicas at several batch sizes, reporting
     the ops/sec curve. Answers empirically whether batching amortizes the
@@ -683,16 +718,22 @@ def _pid_is(pid: int, needle: bytes) -> bool:
         return True
 
 
-def _acquire_device_lock(timeout_s: int = 7200) -> None:
+def _acquire_device_lock(timeout_s: int = 10800) -> None:
     """Mutual exclusion between concurrent device phases (bench.py main
     vs device_watcher.py): two processes driving the tunneled chip at
     once would bill each other's contention as kernel time. Blocks while
     a LIVE holder exists, up to timeout_s — after that we proceed anyway
     (the round-end bench run must never be starved by a hung watcher);
     a dead holder's lock is stolen immediately. The default exceeds the
-    worst-case phase duration (sum of per-bench subprocess timeouts
-    ~74 min, plus in-lock probe and per-bench wedge retries), so a
-    healthy long-running phase is never stolen from."""
+    worst-case phase duration: per-bench subprocess timeouts sum to
+    ~84 min (the 1500 s sweep included), and a phase where several
+    non-consecutive benches earn a wedge retry can roughly double that
+    before the 2-strike breaker trips — stealing from a phase that is
+    merely slow would cause the exact contamination the lock prevents,
+    so the deadline errs long (a genuinely hung holder is a DEAD pid
+    and is stolen immediately anyway; the deadline only matters for a
+    live-but-stuck holder, which per-bench subprocess timeouts make
+    near-impossible)."""
     deadline = time.time() + timeout_s
     while True:
         try:
@@ -809,10 +850,23 @@ def _run_device_phase_locked(full: dict, probe: dict,
             return full[name]
         r = fn()
         full[name] = r
+        # Partial-ok results (cumulative-progress bench timed out or
+        # crashed mid-run) keep their data but must neither reset the
+        # wedge breaker (a mid-run timeout IS wedge evidence) nor bank
+        # as a completed run — the `_partial` summary key keeps the
+        # bench on the watcher's retry list for every bench kind.
+        partial = r.get("partial_timeout") or r.get("partial_crash")
+        if r.get("ok") and partial:
+            out[f"{name}_partial"] = str(partial)[:120]
         if not r.get("ok") and _is_wedge(r):
             consecutive_wedges += 1
-        elif r.get("ok"):
+        elif r.get("ok") and r.get("partial_timeout"):
+            consecutive_wedges += 1     # device stopped answering mid-run
+        elif r.get("ok") and not partial:
             consecutive_wedges = 0
+        # ok+partial_crash: leave the count unchanged — the worker
+        # crash-restarts (observed 2026-07-31) and may serve the next
+        # bench, but it is not evidence the tunnel is healthy either
         return r
 
     # Flagship first: the primary-metric corpus on the merge kernel.
@@ -827,33 +881,6 @@ def _run_device_phase_locked(full: dict, probe: dict,
         out["tpu_merge_git_makefile_docs_per_call"] = int(r.get("chunk", 8))
     else:
         out["tpu_merge_git_makefile_error"] = _short_err(r)
-
-    # Self-sufficient device merge (origin extraction on device): the
-    # round-3 flagship. git-makefile is the primary corpus; friendsforever
-    # exercises the deep-entry shape.
-    for corpus, chunk in (("git-makefile.dt", 8), ("friendsforever.dt", 8)):
-        kb = "tpu_zone_" + corpus.split(".")[0].replace("-", "_")
-        r = guarded(kb, lambda c=corpus, k=chunk: bench_device_zone(c, k))
-        if r.get("ok"):
-            out[f"{kb}_ops_per_sec"] = round(r["value"])
-            if r.get("per_call_ms") is not None:
-                out[f"{kb}_per_call_ms"] = r.get("per_call_ms")
-            if r.get("host_prep_ms") is not None:
-                out[f"{kb}_prep_ms"] = r.get("host_prep_ms")
-        else:
-            out[f"{kb}_error"] = _short_err(r)
-
-    # Pallas materialize stage on the flagship corpus (SURVEY §7 step 6).
-    r = guarded("tpu_merge_git_makefile_pallas",
-                lambda: bench_device_merge("git-makefile.dt", 8,
-                                           pallas=True))
-    if r.get("ok"):
-        out["tpu_merge_git_makefile_pallas_ops_per_sec"] = round(r["value"])
-        if r.get("per_call_ms") is not None:
-            out["tpu_merge_git_makefile_pallas_per_call_ms"] = \
-                r.get("per_call_ms")
-    else:
-        out["tpu_merge_git_makefile_pallas_error"] = _short_err(r)
 
     # Batch-amortization sweep (BASELINE config 4 at its written scale).
     r = guarded("tpu_merge_node_nodecc_sweep",
@@ -898,6 +925,39 @@ def _run_device_phase_locked(full: dict, probe: dict,
         out["fanin_10k_propagation_ms"] = round(r["value"], 3)
     else:
         out["fanin_10k_error"] = _short_err(r)
+
+    # Crash-risk benches LAST (observed 2026-07-31: the zone kernel and
+    # the pallas merge each took down the TPU worker — "kernel fault" —
+    # and the wedged tunnel then starved every bench scheduled after
+    # them for the rest of the live window). Running them after the safe
+    # set means a crash can only cost benches that already ran.
+    #
+    # Self-sufficient device merge (origin extraction on device): the
+    # round-3 flagship. git-makefile is the primary corpus; friendsforever
+    # exercises the deep-entry shape.
+    for corpus, chunk in (("git-makefile.dt", 8), ("friendsforever.dt", 8)):
+        kb = "tpu_zone_" + corpus.split(".")[0].replace("-", "_")
+        r = guarded(kb, lambda c=corpus, k=chunk: bench_device_zone(c, k))
+        if r.get("ok"):
+            out[f"{kb}_ops_per_sec"] = round(r["value"])
+            if r.get("per_call_ms") is not None:
+                out[f"{kb}_per_call_ms"] = r.get("per_call_ms")
+            if r.get("host_prep_ms") is not None:
+                out[f"{kb}_prep_ms"] = r.get("host_prep_ms")
+        else:
+            out[f"{kb}_error"] = _short_err(r)
+
+    # Pallas materialize stage on the flagship corpus (SURVEY §7 step 6).
+    r = guarded("tpu_merge_git_makefile_pallas",
+                lambda: bench_device_merge("git-makefile.dt", 8,
+                                           pallas=True))
+    if r.get("ok"):
+        out["tpu_merge_git_makefile_pallas_ops_per_sec"] = round(r["value"])
+        if r.get("per_call_ms") is not None:
+            out["tpu_merge_git_makefile_pallas_per_call_ms"] = \
+                r.get("per_call_ms")
+    else:
+        out["tpu_merge_git_makefile_pallas_error"] = _short_err(r)
     _flush_partial(full, out)
     return out
 
@@ -943,19 +1003,28 @@ def bench_is_active() -> bool:
 
 
 def main() -> None:
-    with open(BENCH_ACTIVE, "w") as f:
-        f.write(str(os.getpid()))
+    # Never stomp a LIVE holder's pidfile: if two bench runs overlap and
+    # the second overwrote the marker then finished first, its cleanup
+    # would drop the guard while the first run is still benching (the
+    # campaigns would resume and contaminate it). The overlapping run is
+    # itself contamination either way; leaving the existing guard up is
+    # the conservative choice for both runs.
+    owned = not bench_is_active()
+    if owned:
+        with open(BENCH_ACTIVE, "w") as f:
+            f.write(str(os.getpid()))
     try:
         _main()
     finally:
-        try:
-            # remove only our own marker: a second bench invocation that
-            # overwrote the pidfile and finished first must not drop the
-            # guard for a run still in flight
-            if int(open(BENCH_ACTIVE).read().strip() or "0") == os.getpid():
-                os.remove(BENCH_ACTIVE)
-        except (OSError, ValueError):
-            pass
+        if owned:
+            try:
+                # remove only our own marker (a stale-dead holder's file
+                # we replaced above must not be dropped by *their* exit)
+                if int(open(BENCH_ACTIVE).read().strip() or "0") \
+                        == os.getpid():
+                    os.remove(BENCH_ACTIVE)
+            except (OSError, ValueError):
+                pass
 
 
 def _main() -> None:

@@ -96,16 +96,29 @@ def _bench_ok(keys: dict) -> bool:
     return any(not k.endswith("_error") for k in keys)
 
 
+def _bench_full_ok(keys: dict) -> bool:
+    """Ok data from a run that COMPLETED (no `_partial` marker — a sweep
+    that timed out / crashed mid-curve banks its points but stays
+    retryable)."""
+    return _bench_ok(keys) and not any(k.endswith("_partial")
+                                       for k in keys)
+
+
 def _merge_summary(old: dict, new: dict) -> dict:
-    """Bench-level merge that can only improve the bank: a bench's keys
-    are replaced when the new run has ok data for it; a new error lands
-    only if the bank has no ok data for that bench; global keys
-    (platform, RTT) are overwritten."""
+    """Bench-level merge that can only improve the bank: full-ok data is
+    terminal; partial-ok data (timeout/crash mid-run, `_partial` marker)
+    replaces errors and older partials but never full-ok data; a new
+    error lands only if the bank has no ok data for that bench; global
+    keys (platform, RTT) are overwritten."""
     old_per, old_glob = _group(old)
     new_per, new_glob = _group(new)
     merged = {}
     for b in BENCHES:
-        if _bench_ok(new_per[b]):
+        if _bench_full_ok(old_per[b]):
+            take = old_per[b] if not _bench_full_ok(new_per[b]) \
+                else new_per[b]       # both full: later window wins
+            merged.update(take)
+        elif _bench_ok(new_per[b]):
             merged.update(new_per[b])
         elif _bench_ok(old_per[b]):
             merged.update(old_per[b])
@@ -118,9 +131,10 @@ def _merge_summary(old: dict, new: dict) -> dict:
 
 
 def _catch_complete(summary: dict) -> bool:
-    """Complete = every device bench has banked ok data."""
+    """Complete = every device bench has banked ok data from a COMPLETED
+    run (partial sweeps keep the bench on the retry list)."""
     per, _ = _group(summary)
-    return all(_bench_ok(per[b]) for b in BENCHES)
+    return all(_bench_full_ok(per[b]) for b in BENCHES)
 
 
 def _bank_run(run_label: str, summary: dict = None,
@@ -226,11 +240,12 @@ def main() -> None:
             except OSError:
                 pass
             label = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
-            # spend the window on what's missing: benches with banked ok
-            # data are skipped inside the phase (their skip errors are
-            # discarded by the bank merge)
+            # spend the window on what's missing: benches with banked
+            # COMPLETE ok data are skipped inside the phase (their skip
+            # errors are discarded by the bank merge; partial catches
+            # stay on the retry list)
             per, _g = _group(banked)
-            already = frozenset(b for b in BENCHES if _bench_ok(per[b]))
+            already = frozenset(b for b in BENCHES if _bench_full_ok(per[b]))
             phase_full, phase_out = {}, None
             try:
                 phase_out = bench._run_device_phase(phase_full, probe=probe,

@@ -28,6 +28,22 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 
+def _gather_lanes(tab, idx):
+    """take_along_axis(tab, idx, axis=1) in the one gather form Mosaic
+    lowers (`tpu.dynamic_gather`): same-shape [b, n] operand/indices/out
+    with operand_batching_dims=(0,). jnp.take_along_axis itself emits
+    offset_dims=(0,) when b == 1 (a size-1 batch dim is folded into the
+    slice), which Mosaic rejects — so build the batched form explicitly.
+    Indices must already be in [0, n)."""
+    return jax.lax.gather(
+        tab, idx[..., None],
+        dimension_numbers=jax.lax.GatherDimensionNumbers(
+            offset_dims=(), collapsed_slice_dims=(1,), start_index_map=(1,),
+            operand_batching_dims=(0,), start_indices_batching_dims=(0,)),
+        slice_sizes=(1, 1),
+        mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+
+
 def _apply_op_kernel(pos_ref, dlen_ref, ilen_ref, chars_ref, doc_ref,
                      len_ref, out_doc_ref, out_len_ref):
     """One op applied to a [block, cap] slab of documents (all in VMEM).
@@ -35,44 +51,51 @@ def _apply_op_kernel(pos_ref, dlen_ref, ilen_ref, chars_ref, doc_ref,
     out[i] = chars[i - pos]          for pos <= i < pos+ilen   (insert lane)
            = doc[i]                  for i < pos
            = doc[i - ilen + dlen]    for i >= pos+ilen         (tail shift)
+
+    Mosaic's gather (`tpu.dynamic_gather`) only lowers take_along_axis
+    when operand, indices and output shapes all match, so `chars` arrives
+    pre-padded to [b, cap] by the wrapper and every gather here is
+    same-shape [b, cap].
     """
     doc = doc_ref[...]                      # [b, cap] int32
     pos = pos_ref[...][:, None]             # [b, 1]
     dlen = dlen_ref[...][:, None]
     ilen = ilen_ref[...][:, None]
-    chars = chars_ref[...]                  # [b, max_ins]
+    chars = chars_ref[...]                  # [b, cap] (zero-padded tail)
     cap = doc.shape[1]
     idx = jax.lax.broadcasted_iota(jnp.int32, doc.shape, 1)
 
     shift = ilen - dlen
     src = jnp.where(idx < pos, idx, idx - shift)
-    gathered = jnp.take_along_axis(doc, jnp.clip(src, 0, cap - 1), axis=1)
-    ins_idx = jnp.clip(idx - pos, 0, chars.shape[1] - 1)
-    ins_vals = jnp.take_along_axis(chars, ins_idx, axis=1)
+    gathered = _gather_lanes(doc, jnp.clip(src, 0, cap - 1))
+    ins_idx = jnp.clip(idx - pos, 0, cap - 1)
+    ins_vals = _gather_lanes(chars, ins_idx)
     in_insert = (idx >= pos) & (idx < pos + ilen)
     new_doc = jnp.where(in_insert, ins_vals, gathered)
 
     noop = (ilen == 0) & (dlen == 0)
     out_doc_ref[...] = jnp.where(noop, doc, new_doc)
-    out_len_ref[...] = len_ref[...] + jnp.where(noop[:, 0], 0,
-                                                (ilen - dlen)[:, 0])
+    out_len_ref[...] = len_ref[...] + jnp.where(noop, 0, ilen - dlen)
 
 
 def apply_op_block(pos, dlen, ilen, chars, doc, doc_len, *,
                    interpret: bool = False):
     """Apply one positional op per document to a [b, cap] batch (Pallas)."""
     b, cap = doc.shape
+    if chars.shape[1] < cap:      # same-shape gather table (see kernel doc)
+        chars = jnp.pad(chars, ((0, 0), (0, cap - chars.shape[1])))
     kwargs = {}
     if not interpret and _VMEM is not None:
         spec = pl.BlockSpec(memory_space=_VMEM)
         kwargs = {"in_specs": [spec] * 6, "out_specs": (spec, spec)}
-    return pl.pallas_call(
+    doc_out, len2d = pl.pallas_call(
         _apply_op_kernel,
         out_shape=(jax.ShapeDtypeStruct((b, cap), jnp.int32),
-                   jax.ShapeDtypeStruct((b,), jnp.int32)),
+                   jax.ShapeDtypeStruct((b, 1), jnp.int32)),
         interpret=interpret,
         **kwargs,
-    )(pos, dlen, ilen, chars, doc, doc_len)
+    )(pos, dlen, ilen, chars, doc, doc_len[:, None])
+    return doc_out, len2d[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -80,34 +103,50 @@ def apply_op_block(pos, dlen, ilen, chars, doc, doc_len, *,
 # ---------------------------------------------------------------------------
 
 
-def _materialize_kernel(starts_ref, base_ref, arena_ref, total_ref,
-                        out_ref, *, n_pow: int):
+def _materialize_kernel(starts_ref, ends_ref, base_ref, arena_ref,
+                        out_ref, *, n_pow: int, tiles: int):
     """Expand visible runs into text for one [block] of output positions.
 
     Gather-only formulation (TPU Pallas has fast gathers, no fast
     scatter): each output position j binary-searches the compacted live
-    runs' start table (log2(n) vectorized steps), then reads its char
+    runs' start table (log2(block) vectorized steps), then reads its char
     through the run's affine base. Replaces materialize_jax's
-    scatter+cummax run expansion for the device merge path."""
-    j = jax.lax.broadcasted_iota(jnp.int32, (1, out_ref.shape[1]), 1) + \
-        pl.program_id(0) * out_ref.shape[1]
-    starts = starts_ref[...]               # [1, n] (+inf padded, sorted)
-    base = base_ref[...]                   # [1, n]
-    arena = arena_ref[...]                 # [1, A]
-    total = total_ref[0]
+    scatter+cummax run expansion for the device merge path.
 
-    # binary search: largest r with starts[r] <= j
+    Mosaic's gather only lowers same-shape take_along_axis, so the run
+    tables arrive padded to [1, block] and the arena lookup walks
+    `tiles` static [1, block] slices of the arena, selecting the tile
+    that covers each position's source index.
+    """
+    block = out_ref.shape[1]
+    j = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1) + \
+        pl.program_id(0) * block
+    starts = starts_ref[...]               # [1, block] (+inf pad, sorted)
+    ends = ends_ref[...]                   # [1, block] run end positions
+    base = base_ref[...]                   # [1, block]
+
+    # binary search: largest r with starts[r] <= j  (same-shape gathers)
     lo = jnp.zeros_like(j)
+    step = jnp.full_like(j, 1 << (n_pow - 1))
     for _ in range(n_pow):
-        step = jnp.full_like(j, 1 << (n_pow - 1)) if _ == 0 else step // 2
         probe = lo + step
-        pv = jnp.take_along_axis(
-            starts, jnp.clip(probe, 0, starts.shape[1] - 1), axis=1)
-        lo = jnp.where((probe < starts.shape[1]) & (pv <= j), probe, lo)
-    b = jnp.take_along_axis(base, lo, axis=1)
-    src = jnp.clip(b + j, 0, arena.shape[1] - 1)
-    text = jnp.take_along_axis(arena, src, axis=1)
-    out_ref[...] = jnp.where(j < total, text, 0)
+        pv = _gather_lanes(starts, jnp.clip(probe, 0, block - 1))
+        lo = jnp.where((probe < block) & (pv <= j), probe, lo)
+        step = step // 2
+    b = _gather_lanes(base, lo)
+    src = b + j                            # arena index per position
+    # in-range ⟺ j lands inside its run's [start, end): beyond-total
+    # positions bind to the last live run and fail j < end (no SMEM
+    # scalar needed — a scalar block spec does not survive vmap)
+    valid = j < _gather_lanes(ends, lo)
+    text = jnp.zeros_like(j)
+    for t in range(tiles):                 # tiled same-shape arena gather
+        tile = arena_ref[:, t * block:(t + 1) * block]
+        local = src - t * block
+        hit = (local >= 0) & (local < block)
+        g = _gather_lanes(tile, jnp.clip(local, 0, block - 1))
+        text = jnp.where(hit, g, text)
+    out_ref[...] = jnp.where(valid, text, 0)
 
 
 def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
@@ -115,10 +154,20 @@ def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
     """Drop-in for linearize.materialize_jax with the run expansion in a
     Pallas kernel. The XLA pre-pass compacts live runs (sorted starts +
     affine bases — one cumsum and one scatter over [n]); the [cap]-wide
-    expansion (the hot part) runs in VMEM."""
+    expansion (the hot part) runs in VMEM. Falls back to materialize_jax
+    when the run table cannot fit one output block (the same-shape gather
+    bound; >64Ki live runs)."""
     if not interpret and jax.default_backend() != "tpu":
         interpret = True   # CPU/GPU backends run the kernel interpreted
     n = perm.shape[0]
+
+    # Lane-aligned block: multiple of 128, covers the run table.
+    block = max(128, min(_next_pow2(max(cap, 1)), 64 * 1024))
+    n_pad = max(1, _next_pow2(n))
+    if n_pad > block:
+        from .linearize import materialize_jax
+        return materialize_jax(perm, vis_len, arena_off, arena, cap)
+
     vl = vis_len[perm]
     cum = jnp.cumsum(vl)
     total = (cum[-1] if n else jnp.int32(0)).astype(jnp.int32)
@@ -127,53 +176,46 @@ def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
     live = vl > 0
     # compact live runs to a sorted prefix; pad tail with +inf starts
     k = jnp.cumsum(live.astype(jnp.int32)) - 1
-    n_pad = max(1, _next_pow2(n))
     INF = jnp.int32(2 ** 30)
-    starts_c = jnp.full((n_pad,), INF, jnp.int32).at[
-        jnp.where(live, k, n_pad - 1)].set(
+    starts_c = jnp.full((block,), INF, jnp.int32).at[
+        jnp.where(live, k, block - 1)].set(
         jnp.where(live, starts, INF).astype(jnp.int32), mode="drop")
-    base_c = jnp.zeros((n_pad,), jnp.int32).at[
-        jnp.where(live, k, n_pad - 1)].set(
+    ends_c = jnp.zeros((block,), jnp.int32).at[
+        jnp.where(live, k, block - 1)].set(
+        jnp.where(live, cum, 0).astype(jnp.int32), mode="drop")
+    base_c = jnp.zeros((block,), jnp.int32).at[
+        jnp.where(live, k, block - 1)].set(
         jnp.where(live, base, 0).astype(jnp.int32), mode="drop")
-    # guard slot 0: with no live runs at position 0 the search floor must
-    # still be a valid run for padded positions (masked by `total` anyway)
     arena_i = arena.astype(jnp.int32)
     A = arena_i.shape[0]
+    tiles = max(1, (A + block - 1) // block)
+    A_pad = tiles * block
+    if A_pad > A:
+        arena_i = jnp.pad(arena_i, (0, A_pad - A))
 
-    block = min(cap, 64 * 1024)
     grid = (cap + block - 1) // block
-    kwargs = {}
     if not interpret and _VMEM is not None:
-        kwargs = {
-            "in_specs": [
-                pl.BlockSpec((1, n_pad), lambda i: (0, 0),
-                             memory_space=_VMEM),
-                pl.BlockSpec((1, n_pad), lambda i: (0, 0),
-                             memory_space=_VMEM),
-                pl.BlockSpec((1, A), lambda i: (0, 0),
-                             memory_space=_VMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-            ],
-            "out_specs": pl.BlockSpec((1, block), lambda i: (0, i),
-                                      memory_space=_VMEM),
-        }
+        table_spec = pl.BlockSpec((1, block), lambda i: (0, 0),
+                                  memory_space=_VMEM)
+        arena_spec = pl.BlockSpec((1, A_pad), lambda i: (0, 0),
+                                  memory_space=_VMEM)
+        out_spec = pl.BlockSpec((1, block), lambda i: (0, i),
+                                memory_space=_VMEM)
     else:
-        kwargs = {
-            "in_specs": [pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
-                         pl.BlockSpec((1, n_pad), lambda i: (0, 0)),
-                         pl.BlockSpec((1, A), lambda i: (0, 0)),
-                         pl.BlockSpec((1,), lambda i: (0,))],
-            "out_specs": pl.BlockSpec((1, block), lambda i: (0, i)),
-        }
+        table_spec = pl.BlockSpec((1, block), lambda i: (0, 0))
+        arena_spec = pl.BlockSpec((1, A_pad), lambda i: (0, 0))
+        out_spec = pl.BlockSpec((1, block), lambda i: (0, i))
     out = pl.pallas_call(
         functools.partial(_materialize_kernel,
-                          n_pow=max(1, (n_pad - 1).bit_length())),
+                          n_pow=max(1, (block - 1).bit_length()),
+                          tiles=tiles),
         grid=(grid,),
+        in_specs=[table_spec, table_spec, table_spec, arena_spec],
+        out_specs=out_spec,
         out_shape=jax.ShapeDtypeStruct((1, grid * block), jnp.int32),
         interpret=interpret,
-        **kwargs,
-    )(starts_c[None, :], base_c[None, :], arena_i[None, :],
-      total[None])
+    )(starts_c[None, :], ends_c[None, :], base_c[None, :],
+      arena_i[None, :])
     return out[0, :cap], total
 
 

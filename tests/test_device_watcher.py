@@ -223,3 +223,45 @@ def test_phase_skip_runs_no_subprocess(lockdir):
     assert all("already banked" in v for v in errs.values())
     assert out["device_platform"] == "cpu"
     assert not os.path.exists(bench.DEVICE_LOCK)
+
+
+def test_partial_results_bank_but_stay_retryable(dw):
+    """A sweep that timed out / crashed mid-curve banks its completed
+    points (marked `_partial`), is NOT counted complete, is retried
+    (not in the skip set), and is replaced by a later full run — while
+    never downgrading an existing full result."""
+    partial = {"tpu_merge_node_nodecc_best_ops_per_sec": 5e6,
+               "tpu_merge_node_nodecc_best_chunk": 8,
+               "tpu_merge_node_nodecc_sweep_partial": "timed out at 64"}
+    m = dw._merge_summary({}, partial)
+    assert m["tpu_merge_node_nodecc_best_ops_per_sec"] == 5e6
+    per, _ = dw._group(m)
+    b = "tpu_merge_node_nodecc_sweep"
+    # the partial marker classifies to its bench and blocks completeness
+    assert dw._bench_of("tpu_merge_node_nodecc_sweep_partial") == b
+    assert dw._bench_ok(per[b]) and not dw._bench_full_ok(per[b])
+    assert not dw._catch_complete({**m,
+        **{f"{x}_ok": 1 for x in dw.BENCHES if x != b}})
+
+    # partial beats error, later partial beats earlier partial
+    m2 = dw._merge_summary({"tpu_merge_node_nodecc_sweep_error": "wedge"},
+                           partial)
+    assert "tpu_merge_node_nodecc_sweep_error" not in m2
+    later = {"tpu_merge_node_nodecc_best_ops_per_sec": 6e6,
+             "tpu_merge_node_nodecc_sweep_partial": "crash at 1024"}
+    m3 = dw._merge_summary(m, later)
+    assert m3["tpu_merge_node_nodecc_best_ops_per_sec"] == 6e6
+
+    # a full run replaces the partial AND clears the marker
+    full = {"tpu_merge_node_nodecc_best_ops_per_sec": 9e6,
+            "tpu_merge_node_nodecc_best_chunk": 1024}
+    m4 = dw._merge_summary(m, full)
+    assert m4["tpu_merge_node_nodecc_best_ops_per_sec"] == 9e6
+    assert "tpu_merge_node_nodecc_sweep_partial" not in m4
+    per4, _ = dw._group(m4)
+    assert dw._bench_full_ok(per4[b])
+
+    # and a later PARTIAL never downgrades a banked full result
+    m5 = dw._merge_summary(m4, partial)
+    assert m5["tpu_merge_node_nodecc_best_ops_per_sec"] == 9e6
+    assert "tpu_merge_node_nodecc_sweep_partial" not in m5

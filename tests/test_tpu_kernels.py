@@ -283,3 +283,52 @@ def test_materialize_pallas_corpus():
     got = np.asarray(text)[:int(total)].astype(np.int32).tobytes() \
         .decode("utf-32-le")
     assert got == ol.checkout_tip().snapshot()
+
+
+def test_pallas_kernels_lower_for_tpu():
+    """Offline Mosaic lowering of every Pallas kernel (no TPU needed:
+    .lower(lowering_platforms=('tpu',)) runs the full Mosaic kernel
+    lowering pass on any backend).
+
+    Regression for the 2026-07-31 on-chip failure: interpret-mode tests
+    pass shape-mismatched gathers that Mosaic's `tpu.dynamic_gather`
+    rejects (it only lowers same-shape take_along_axis, and
+    jnp.take_along_axis on a [1, n] operand emits the offset_dims form
+    Mosaic does not support at all — see _gather_lanes). The real
+    tpu_merge_git_makefile_pallas bench died at compile time three
+    rounds in a row while CI stayed green; this test makes the lowering
+    contract a host-side assertion."""
+    import unittest.mock as mock
+
+    import jax
+    import jax.numpy as jnp
+    from diamond_types_tpu.tpu import pallas_kernels as pk
+
+    perm = jnp.arange(200, dtype=jnp.int32)
+    vis = jnp.ones(200, dtype=jnp.int32)
+    aoff = jnp.arange(200, dtype=jnp.int32)
+    arena = jnp.zeros(70000, dtype=jnp.int32)
+
+    def mat(perm, vis, aoff, arena):
+        return pk.materialize_pallas(perm, vis, aoff, arena, cap=300,
+                                     interpret=False)
+
+    # materialize_pallas consults jax.default_backend() to pick the
+    # interpret fallback; pretend to be on TPU so the real kernel lowers.
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        jax.jit(mat).trace(perm, vis, aoff, arena).lower(
+            lowering_platforms=("tpu",))
+        # the merge kernel runs it under vmap (batched checkout)
+        jax.jit(jax.vmap(mat)).trace(
+            perm[None].repeat(4, 0), vis[None].repeat(4, 0),
+            aoff[None].repeat(4, 0), arena[None].repeat(4, 0)).lower(
+            lowering_platforms=("tpu",))
+
+    pos = jnp.zeros((8,), jnp.int32)
+    dl = jnp.zeros((8,), jnp.int32)
+    il = jnp.ones((8,), jnp.int32)
+    ch = jnp.zeros((8, 16), jnp.int32)
+    doc = jnp.zeros((8, 256), jnp.int32)
+    dlen = jnp.zeros((8,), jnp.int32)
+    jax.jit(lambda *a: pk.apply_op_block(*a, interpret=False)).trace(
+        pos, dl, il, ch, doc, dlen).lower(lowering_platforms=("tpu",))
