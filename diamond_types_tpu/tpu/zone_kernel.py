@@ -714,7 +714,7 @@ _zone_batch_jit_cache = {}
 
 def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
                            seq_k: np.ndarray, batch: int,
-                           replica_sharding=None):
+                           replica_sharding=None, xs=None):
     """Batched replica execution: ONE shared tape, `batch` independent
     state evolutions (the many-docs-per-chip deployment shape — BASELINE
     config 4). seq keys are materialized per replica so every row is a
@@ -737,8 +737,9 @@ def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
                         MB=MB, MC=MC, MD=MD)
         fn = jax.jit(jax.vmap(inner, in_axes=(None, None, 0)))
         _zone_batch_jit_cache[key] = fn
-    xs = _pad_tape_xs(tape)
-    xs = {k: jnp.asarray(v) for k, v in xs.items()}
+    if xs is None:
+        xs = _pad_tape_xs(tape)
+        xs = {k: jnp.asarray(v) for k, v in xs.items()}
     seq_b = jnp.asarray(
         np.broadcast_to(seq_k.astype(np.int32), (batch, W)).copy())
     if replica_sharding is not None:
@@ -747,9 +748,93 @@ def execute_zone_batch_jax(tape: ZoneTape, agent_k: np.ndarray,
     return rank, ever   # DEVICE arrays: callers np.asarray (or slice) them
 
 
-def _pad_tape_xs(tape: ZoneTape) -> dict:
+def _run_zone_slice(carry, xs, W: int, plen: int, n_idx: int, MB: int,
+                    MC: int, MD: int):
+    """One bounded-length scan segment: carry in, carry out."""
+    from jax import lax
+
+    step = make_zone_step(W, plen, n_idx, MB, MC, MD)
+    final, _ = lax.scan(step, carry, xs)
+    return final
+
+
+def slice_tape_xs(tape: ZoneTape, slice_steps: int):
+    """Cut the padded tape into device-resident scan segments of length
+    `slice_steps` (pad steps are self-FORK no-ops, so over-padding the
+    last segment is safe). Returns (S, [xs dicts on device])."""
+    import jax.numpy as jnp
+
+    if int(slice_steps) <= 0:
+        raise ValueError(f"slice_steps must be positive, got {slice_steps}"
+                         " (use the whole-tape executor to disable slicing)")
     T = tape.op.shape[0]
-    Tp = _pow2(T)
+    S = min(int(slice_steps), _pow2(T))
+    n_sl = max(1, -(-T // S))
+    xs_np = _pad_tape_xs(tape, target=n_sl * S)
+    return S, [{k: jnp.asarray(v[i * S:(i + 1) * S])
+                for k, v in xs_np.items()} for i in range(n_sl)]
+
+
+_zone_slice_jit_cache = {}
+
+
+def execute_zone_batch_sliced_jax(tape: ZoneTape, agent_k: np.ndarray,
+                                  seq_k: np.ndarray, batch: int,
+                                  slice_steps: int = 32768,
+                                  xs_slices=None):
+    """execute_zone_batch_jax semantics with the whole-tape scan split
+    into bounded-length dispatches (carry stays device-resident between
+    calls, so the only extra cost is per-slice dispatch).
+
+    Motivation (2026-07-31, first live tunnel window in three rounds):
+    the single whole-tape scan — 524k scan steps on git-makefile —
+    reproducibly killed the TPU worker on the tunneled v5e runtime
+    (\"TPU worker process crashed or restarted ... kernel fault\") on
+    every corpus, while short-program benches on the same chip ran
+    clean. Bounding device time per dispatch keeps each program inside
+    whatever execution budget that runtime enforces, and is the right
+    shape for a tunneled deployment anyway: liveness probes and other
+    work interleave at slice boundaries instead of queueing behind a
+    minutes-long program. Returns (rank [B, W], ever [B, W]) as DEVICE
+    arrays, like the whole-tape batch executor."""
+    import jax
+    import jax.numpy as jnp
+
+    W, plen, n_idx = tape.W, tape.plen, tape.n_idx
+    MB, MC, MD = (tape.blk_cursor.shape[1], tape.ch_slot.shape[1],
+                  tape.del_kind.shape[1])
+    if xs_slices is None:
+        S, xs_slices = slice_tape_xs(tape, slice_steps)
+    else:
+        S = int(xs_slices[0]["op"].shape[0])
+    key = (W, plen, n_idx, S, MB, MC, MD, batch)
+    fns = _zone_slice_jit_cache.get(key)
+    if fns is None:
+        inner = partial(_run_zone_slice, W=W, plen=plen, n_idx=n_idx,
+                        MB=MB, MC=MC, MD=MD)
+        # donate the dead previous carry (zone_session._micro_fn
+        # pattern): each slice updates the batched state in place
+        # instead of doubling peak device memory per dispatch
+        fn = jax.jit(jax.vmap(inner, in_axes=(0, None)),
+                     donate_argnums=0)
+        init = jax.jit(jax.vmap(
+            partial(init_zone_carry, W, plen, n_idx), in_axes=(None, 0)))
+        fns = (fn, init)
+        _zone_slice_jit_cache[key] = fns
+    fn, init = fns
+    agent_j = jnp.asarray(agent_k.astype(np.int32))
+    seq_b = jnp.asarray(
+        np.broadcast_to(seq_k.astype(np.int32), (batch, W)).copy())
+    carry = init(agent_j, seq_b)
+    for xs in xs_slices:
+        carry = fn(carry, xs)
+    return carry[2], carry[6]
+
+
+def _pad_tape_xs(tape: ZoneTape, target: Optional[int] = None) -> dict:
+    T = tape.op.shape[0]
+    Tp = _pow2(T) if target is None else int(target)
+    assert Tp >= T
 
     def pad_t(a, fill=0):
         out = np.full((Tp,) + a.shape[1:], fill, a.dtype)

@@ -145,3 +145,45 @@ def test_batched_pack_columns_match_per_entry():
               "ch_ol_static", "ch_ol_coord", "ch_orr_own", "ch_blk",
               "ch_agent", "ch_seq", "del_kind", "del_a", "del_b"):
         assert np.array_equal(getattr(tape, f), getattr(tape2, f)), f
+
+
+@pytest.mark.parametrize("slice_steps", [7, 64, 1 << 20])
+def test_sliced_executor_matches_whole_tape(slice_steps):
+    """execute_zone_batch_sliced_jax (bounded-length dispatches for the
+    tunneled runtime that kills minutes-long programs, 2026-07-31) is
+    bit-identical to the whole-tape scan — uneven slice boundaries,
+    slice == 1 step short of a block, and slice > tape all covered."""
+    import numpy as np
+    from diamond_types_tpu.listmerge.zone_np import prepare_zone
+    from diamond_types_tpu.tpu.zone_kernel import (
+        execute_zone_batch_jax, execute_zone_batch_sliced_jax,
+        pack_zone_tape, slice_tape_xs)
+
+    rng = random.Random(7100)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("alice", "bob")]
+    branches = [([], "")]
+    for _ in range(60):
+        bi = rng.randrange(len(branches))
+        version, content = branches[bi]
+        agent = agents[rng.randrange(len(agents))]
+        version, content = random_edit(rng, ol, agent, version, content)
+        if rng.random() < 0.3 and len(branches) < 4:
+            branches.append((version, content))
+        else:
+            branches[bi] = (version, content)
+    prep = prepare_zone(ol)
+    if not prep.plan.entries:
+        pytest.skip("degenerate zone")
+    tape = pack_zone_tape(prep)
+    r1, e1 = execute_zone_batch_jax(tape, prep.agent_k, prep.seq_k, 2)
+    r2, e2 = execute_zone_batch_sliced_jax(
+        tape, prep.agent_k, prep.seq_k, 2, slice_steps=slice_steps)
+    assert np.array_equal(np.asarray(r1), np.asarray(r2))
+    assert np.array_equal(np.asarray(e1), np.asarray(e2))
+    # prebuilt-slices path (what the bench snippet times) agrees too
+    _, xs = slice_tape_xs(tape, slice_steps)
+    r3, e3 = execute_zone_batch_sliced_jax(
+        tape, prep.agent_k, prep.seq_k, 2, xs_slices=xs)
+    assert np.array_equal(np.asarray(r1), np.asarray(r3))
+    assert np.array_equal(np.asarray(e1), np.asarray(e3))
