@@ -202,6 +202,17 @@ threading.Thread(target=_watchdog, daemon=True).start()
 import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+try:
+    # Persistent compile cache shared by every bench subprocess: device
+    # bench retries across watcher windows skip their multi-minute XLA
+    # compiles (the 2026-07-31 sweep lost chunks 64+ to compile time
+    # alone). Harmless if the backend can't serialize executables.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join({repo!r}, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
 import jax.numpy as jnp
 print("PLATFORM", jax.devices()[0].platform, flush=True)
 np.asarray(jnp.arange(4) + 1)   # liveness: forces a real device round-trip
@@ -289,6 +300,11 @@ def bench_tpu_batch(batch: int = 1024, n_ops: int = 256, cap: int = 1024,
 
 _MERGE_KERNEL_SNIPPET = _PRELUDE + """
 os.environ["DT_TPU_PALLAS"] = {pallas!r}
+if {pallas!r}:
+    # a Pallas bench must fail loudly rather than silently report the
+    # XLA fallback's numbers as kernel numbers
+    os.environ["DT_TPU_PALLAS_STRICT"] = "1"
+    os.environ.setdefault("DT_PALLAS_SMEM_RUNS", "32768")
 from diamond_types_tpu.encoding.decode import load_oplog
 from diamond_types_tpu.tpu.merge_kernel import (prepare_doc, pad_docs,
                                                 _jitted_kernel, _pow2)
@@ -828,9 +844,57 @@ def _run_device_phase(full: dict, probe: dict = None,
     try:
         if probe is not None and time.time() - t0 > 120:
             probe = None   # stale after a long lock wait: re-probe
-        return _run_device_phase_locked(full, probe, skip)
+        out = _run_device_phase_locked(full, probe, skip)
     finally:
         _release_device_lock()
+    return _substitute_banked(out, full)
+
+
+def _substitute_banked(out: dict, full: dict) -> dict:
+    """Round-end durability for banked catches (VERDICT r4 #2): a bench
+    that errors NOW but has complete ok data banked by device_watcher.py
+    from an earlier live window reports the banked numbers instead of
+    the error — a late tunnel wedge must not erase on-chip evidence from
+    the round's official record. Substituted benches are listed under
+    `device_bank_used` with the bank's capture time."""
+    bank_path = os.environ.get("DT_DEVICE_BANK") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "DEVICE_BANK.json")
+    try:
+        with open(bank_path) as f:
+            bank_doc = json.load(f)
+        bank = bank_doc.get("summary", {})
+    except (OSError, ValueError):
+        return out
+    # Staleness gate: DEVICE_BANK.json is committed, so a bench run in a
+    # LATER round (or on a copied checkout) would otherwise resurrect a
+    # previous round's numbers as its own. Rounds last ~12 h; catches
+    # older than 18 h are history, not this round's evidence.
+    banked_at = max((r.get("at", 0) for r in bank_doc.get("runs", [])),
+                    default=0)
+    if not banked_at or time.time() - banked_at > 18 * 3600:
+        return out
+    at_iso = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(banked_at))
+    try:
+        import device_watcher as dw
+    except ImportError:
+        return out
+    banked_per, _glob = dw._group(bank)
+    used = {}
+    for b in DEVICE_BENCHES:
+        cur = {k: v for k, v in out.items() if dw._bench_of(k) == b}
+        # preference: complete ok > partial ok (marker kept) > error
+        banked = banked_per[b]
+        take = (dw._bench_full_ok(banked) and not dw._bench_full_ok(cur)) \
+            or (dw._bench_ok(banked) and not dw._bench_ok(cur))
+        if take:
+            for k in cur:
+                del out[k]
+            out.update(banked)
+            used[b] = f"banked {at_iso}"
+    if used:
+        out["device_bank_used"] = {"at": at_iso, "benches": sorted(used)}
+        full["device_bank_used"] = used
+    return out
 
 
 def _run_device_phase_locked(full: dict, probe: dict,

@@ -188,14 +188,29 @@ def _jitted_kernel(cap: int):
     """Compiled batched kernels keyed by the (power-of-two) capacity so
     growing documents reuse O(log max_len) compiled executables instead of
     recompiling per exact length. DT_TPU_PALLAS=1 selects the Pallas
-    materialize stage (pallas_kernels.materialize_pallas)."""
+    materialize stage (pallas_kernels.materialize_pallas); that path
+    unrolls the batch instead of vmapping — the run-copy kernel's grid
+    spans runs, and vmap-of-pallas_call would stack a batch grid dim
+    whose auto-extended block specs violate Pallas TPU block rules."""
     pallas = bool(os.environ.get("DT_TPU_PALLAS"))
     key = (cap, pallas)
     fn = _kernel_cache.get(key)
     if fn is None:
         import jax
-        fn = jax.jit(jax.vmap(partial(_checkout_kernel, cap=cap,
-                                      pallas=pallas)))
+        if pallas:
+            import jax.numpy as jnp
+            single = partial(_checkout_kernel, cap=cap, pallas=True)
+
+            def run_all(*cols):
+                outs = [single(*(c[i] for c in cols))
+                        for i in range(cols[0].shape[0])]
+                return (jnp.stack([t for t, _ in outs]),
+                        jnp.stack([n for _, n in outs]))
+
+            fn = jax.jit(run_all)
+        else:
+            fn = jax.jit(jax.vmap(partial(_checkout_kernel, cap=cap,
+                                          pallas=pallas)))
         _kernel_cache[key] = fn
     return fn
 

@@ -290,19 +290,22 @@ def test_pallas_kernels_lower_for_tpu():
     .lower(lowering_platforms=('tpu',)) runs the full Mosaic kernel
     lowering pass on any backend).
 
-    Regression for the 2026-07-31 on-chip failure: interpret-mode tests
-    pass shape-mismatched gathers that Mosaic's `tpu.dynamic_gather`
-    rejects (it only lowers same-shape take_along_axis, and
-    jnp.take_along_axis on a [1, n] operand emits the offset_dims form
-    Mosaic does not support at all — see _gather_lanes). The real
+    Regression for the 2026-07-31 on-chip failures: interpret-mode tests
+    passed kernels the Mosaic backend cannot compile (first mismatched
+    gather shapes, then dynamic_gather spanning multiple vregs — the
+    backend limit that forced the gather-free redesign). The real
     tpu_merge_git_makefile_pallas bench died at compile time three
     rounds in a row while CI stayed green; this test makes the lowering
-    contract a host-side assertion."""
+    contract a host-side assertion. (The backend's vreg-level layout
+    checks run server-side only, so this cannot catch everything — the
+    kernels are designed against the documented legal-op set instead:
+    scalar-controlled rolls, dynamic-offset block copies, no gathers.)"""
     import unittest.mock as mock
 
     import jax
     import jax.numpy as jnp
     from diamond_types_tpu.tpu import pallas_kernels as pk
+    from diamond_types_tpu.tpu.merge_kernel import _checkout_kernel
 
     perm = jnp.arange(200, dtype=jnp.int32)
     vis = jnp.ones(200, dtype=jnp.int32)
@@ -318,11 +321,6 @@ def test_pallas_kernels_lower_for_tpu():
     with mock.patch.object(jax, "default_backend", lambda: "tpu"):
         jax.jit(mat).trace(perm, vis, aoff, arena).lower(
             lowering_platforms=("tpu",))
-        # the merge kernel runs it under vmap (batched checkout)
-        jax.jit(jax.vmap(mat)).trace(
-            perm[None].repeat(4, 0), vis[None].repeat(4, 0),
-            aoff[None].repeat(4, 0), arena[None].repeat(4, 0)).lower(
-            lowering_platforms=("tpu",))
 
     pos = jnp.zeros((8,), jnp.int32)
     dl = jnp.zeros((8,), jnp.int32)
@@ -332,3 +330,27 @@ def test_pallas_kernels_lower_for_tpu():
     dlen = jnp.zeros((8,), jnp.int32)
     jax.jit(lambda *a: pk.apply_op_block(*a, interpret=False)).trace(
         pos, dl, il, ch, doc, dlen).lower(lowering_platforms=("tpu",))
+
+    # The production DT_TPU_PALLAS=1 entry point: the batch-unrolled
+    # checkout (fugue linearize composed with the pallas materialize) —
+    # the exact function bench_device_merge(pallas=True) compiles.
+    B, n = 3, 64
+    cols = (jnp.full((B, n), n, jnp.int32),          # parent (roots)
+            jnp.zeros((B, n), jnp.int8),             # side
+            jnp.zeros((B, n), jnp.int32),            # key_pos
+            jnp.zeros((B, n), jnp.int32),            # key_agent
+            jnp.arange(n, dtype=jnp.int32)[None].repeat(B, 0),  # key_seq
+            jnp.ones((B, n), jnp.int32),             # vis_len
+            jnp.arange(n, dtype=jnp.int32)[None].repeat(B, 0),  # char_off
+            jnp.full((B, n), 97, jnp.int32))         # chars
+
+    import functools
+
+    def run_all(*cols):
+        single = functools.partial(_checkout_kernel, cap=128, pallas=True)
+        outs = [single(*(c[i] for c in cols)) for i in range(B)]
+        return (jnp.stack([t for t, _ in outs]),
+                jnp.stack([x for _, x in outs]))
+
+    with mock.patch.object(jax, "default_backend", lambda: "tpu"):
+        jax.jit(run_all).trace(*cols).lower(lowering_platforms=("tpu",))
