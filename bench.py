@@ -367,22 +367,65 @@ prep = prepare_zone(ol)        # host: plan compile + entry composition —
 tape = pack_zone_tape(prep)    # NO merge engine anywhere (VERDICT r2 #2)
 prep_ms = (time.perf_counter() - t0) * 1e3
 chunk = {chunk}
-# The tunneled v5e runtime kills minutes-long programs (TPU worker
-# "kernel fault" on every whole-tape run, 2026-07-31): on tpu the scan
-# runs as bounded-length slices with the carry device-resident.
+# The tunneled v5e runtime kills ANY single program past a ~60 s
+# device-time bound (TPU worker "kernel fault"; root-caused 2026-07-31:
+# friendsforever batch 8 as one 7,649-step program dies, the same steps
+# as eight 1,024-step dispatches survive). On tpu the scan therefore
+# runs as sliced dispatches whose length shrinks with batch x W
+# (auto_slice_steps), carry device-resident between them.
 # DT_ZONE_SLICE overrides: a positive value sets the slice length on
 # any backend, 0 forces the whole-tape scan even on tpu.
+from diamond_types_tpu.tpu.zone_kernel import auto_slice_steps
 _sl_env = os.environ.get('DT_ZONE_SLICE')
-slice_steps = (32768 if jax.default_backend() == 'tpu' else 0) \\
+slice_steps = (auto_slice_steps(tape, chunk)
+               if jax.default_backend() == 'tpu' else 0) \\
     if _sl_env is None else max(0, int(_sl_env))
 # Both paths time execution with the tape already device-resident (the
 # deployment shape: a doc's tape uploads once, merges repeat); per-call
 # still includes one tunnel round-trip via bench_call's fetch.
 if slice_steps:
     S, xs_slices = slice_tape_xs(tape, slice_steps)   # upload once
+    n_sl = len(xs_slices)
+    print("SLICE_STEPS", S)
+    print("N_SLICES", n_sl)
     run = lambda: execute_zone_batch_sliced_jax(
         tape, prep.agent_k, prep.seq_k, chunk, xs_slices=xs_slices)
-    print("SLICE_STEPS", S)
+    # Calibrate before committing to the full scan: compile + one
+    # timed slice-prefix pass, then extrapolate the full per-call
+    # time. A corpus whose zone scan cannot fit the bench budget on
+    # this chip (git-makefile: ~500 dispatches at W ~500k) reports
+    # the MEASURED steady-state rate and the extrapolated bound
+    # instead of burning the timeout (parity unchecked — the full
+    # scan never ran; the CPU-backend CI parity covers the kernel).
+    _r = execute_zone_batch_sliced_jax(      # compile (1 dispatch)
+        tape, prep.agent_k, prep.seq_k, chunk, xs_slices=xs_slices[:1])
+    _np.asarray(_r[0][:, :4])
+    K = min(4, n_sl)
+    t0 = time.perf_counter()
+    _r = execute_zone_batch_sliced_jax(
+        tape, prep.agent_k, prep.seq_k, chunk, xs_slices=xs_slices[:K])
+    _np.asarray(_r[0][:, :4])
+    t_k = time.perf_counter() - t0
+    est_call_s = t_k / K * n_sl
+    print("EST_PER_CALL_S", round(est_call_s, 1))
+    # 4 full-call equivalents: warmup + 2 reps, plus the calibration
+    # pass already spent (1+K dispatches ~= one call when n_sl is
+    # small) — a corpus just under a 3x threshold would blow the
+    # subprocess timeout and lose the measurement entirely
+    if est_call_s * 4 > {zone_budget}:
+        print("BOUNDED 1")
+        print("PARITY_CHECKED 0")
+        print("STEP_REPLICAS_PER_S",
+              round(chunk * S * K / t_k))
+        print("CHUNK", chunk)
+        print("HOST_PREP_MS", round(prep_ms, 2))
+        print("TAPE_STEPS", tape.total_steps)
+        print("PER_CALL_MS", round(est_call_s * 1e3, 2))
+        # honest extrapolation from the measured steady-state rate —
+        # the BOUNDED/PARITY_CHECKED keys mark it as a bound, not a
+        # completed, parity-checked merge
+        print("RESULT", chunk * len(ol) / est_call_s)
+        raise SystemExit(0)
 else:
     from diamond_types_tpu.tpu.zone_kernel import _pad_tape_xs
     xs_res = {{k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}}
@@ -399,7 +442,9 @@ for i in range(chunk):
     got = prep.pool[order[vis]].astype(_np.int32).tobytes()\\
         .decode('utf-32-le')
     assert got == expected, 'zone kernel diverged (replica %d)' % i
-dt = bench_call(run, lambda r: r[0][:, :4])
+print("PARITY_CHECKED 1")
+dt = bench_call(run, lambda r: r[0][:, :4],
+                reps=2 if slice_steps else 5)
 print("CHUNK", chunk)
 print("HOST_PREP_MS", round(prep_ms, 2))
 print("TAPE_STEPS", tape.total_steps)
@@ -418,7 +463,7 @@ def bench_device_zone(corpus: str, chunk: int, timeout: int = 600):
     code = _ZONE_MERGE_SNIPPET.format(
         repo=os.path.dirname(os.path.abspath(__file__)),
         data=os.path.join(BENCH_DATA, corpus), chunk=chunk,
-        liveness=LIVENESS_S)
+        liveness=LIVENESS_S, zone_budget=max(60, timeout - 180))
     return _run_device_bench_retry(code, timeout)
 
 
@@ -1024,8 +1069,21 @@ def _run_device_phase_locked(full: dict, probe: dict,
         kb = "tpu_zone_" + corpus.split(".")[0].replace("-", "_")
         r = guarded(kb, lambda c=corpus, k=chunk: bench_device_zone(c, k))
         if r.get("ok"):
-            out[f"{kb}_ops_per_sec"] = round(r["value"])
-            if r.get("per_call_ms") is not None:
+            # A BOUNDED result is a calibration, not a completed merge:
+            # the full scan would blow the bench budget on this chip, so
+            # the snippet reports the measured steady-state rate and the
+            # extrapolated per-call bound under distinct keys (parity
+            # unchecked on device; CPU-backend CI covers the kernel).
+            if r.get("bounded"):
+                out[f"{kb}_bounded_ops_per_sec"] = round(r["value"])
+                out[f"{kb}_bound_per_call_s"] = round(
+                    float(r.get("est_per_call_s", 0)), 1)
+                if r.get("step_replicas_per_s") is not None:
+                    out[f"{kb}_step_replicas_per_s"] = round(
+                        r["step_replicas_per_s"])
+            else:
+                out[f"{kb}_ops_per_sec"] = round(r["value"])
+            if r.get("per_call_ms") is not None and not r.get("bounded"):
                 out[f"{kb}_per_call_ms"] = r.get("per_call_ms")
             if r.get("host_prep_ms") is not None:
                 out[f"{kb}_prep_ms"] = r.get("host_prep_ms")

@@ -146,12 +146,21 @@ def _materialize_runs_kernel(starts_ref, lens_ref, abase_ref, arena_ref,
     """Copy one run's visible chars into the output (grid = runs).
 
     Every run's source is a contiguous arena span, so the expansion is
-    chunked dynamic-offset vector copies with a masked read-modify-write
-    (grid steps are sequential on TPU, and adjacent runs' masks are
-    disjoint, so the RMW is race-free). Runs at/after `cap` are clipped;
-    chunk-tail junk past `cap` lands in the output slack and is sliced
-    off by the wrapper."""
+    chunked vector copies with a masked read-modify-write (grid steps
+    are sequential on TPU, so the window RMW is race-free). Runs
+    at/after `cap` are clipped; chunk-tail junk past `cap` lands in the
+    output slack and is sliced off by the wrapper.
+
+    Alignment (on-chip Mosaic evidence, 2026-07-31): a dynamic
+    lane-dimension `pl.ds` offset must be statically provable as a
+    multiple of 128 ("cannot statically prove that index in dimension 1
+    is a multiple of 128") — arbitrary `a + off` offsets are rejected.
+    All loads/stores therefore use 128-aligned windows (`(idx//128)*128`
+    carries the proof) one vreg wider than the copy chunk, with the
+    sub-tile offsets folded into a single lane rotation of the source
+    window: placed[i] = win[i - (dst%128) + (src%128)]."""
     i = pl.program_id(0)
+    w = cb + 128        # aligned window lanes
 
     @pl.when(i == 0)
     def _init():
@@ -160,17 +169,27 @@ def _materialize_runs_kernel(starts_ref, lens_ref, abase_ref, arena_ref,
     s = starts_ref[0, i]
     n = lens_ref[0, i]
     a = abase_ref[0, i]
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, cb), 1)
+    wlane = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
 
     n_eff = jnp.minimum(n, jnp.maximum(cap - s, 0))   # clip at cap
     n_chunks = (n_eff + cb - 1) // cb
 
     def body(k, _):
         off = k * cb
-        src = arena_ref[:, pl.ds(a + off, cb)]
-        old = out_ref[:, pl.ds(s + off, cb)]
-        mask = (lane + off) < n
-        out_ref[:, pl.ds(s + off, cb)] = jnp.where(mask, src, old)
+        src_idx = a + off
+        dst_idx = s + off
+        ra = jax.lax.rem(src_idx, 128)
+        rd = jax.lax.rem(dst_idx, 128)
+        # aligned bases written as q*128 — the literal multiply is the
+        # form Mosaic's affine analysis accepts as provably aligned
+        qa128 = jax.lax.div(src_idx, 128) * 128
+        qd128 = jax.lax.div(dst_idx, 128) * 128
+        win = arena_ref[:, pl.ds(qa128, w)]
+        old = out_ref[:, pl.ds(qd128, w)]
+        placed = _roll_lanes(win, jnp.mod(rd - ra, w))
+        j = wlane - rd                # window lane → chunk lane
+        mask = (j >= 0) & (j < cb) & ((j + off) < n)
+        out_ref[:, pl.ds(qd128, w)] = jnp.where(mask, placed, old)
         return 0
 
     jax.lax.fori_loop(0, n_chunks, body, 0)
@@ -226,9 +245,10 @@ def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
     abase = arena_off[perm].astype(jnp.int32)
 
     arena_i = arena.astype(jnp.int32)
-    A_pad = _round_up(arena_i.shape[0] + _CB, 128)
+    # window slack: aligned-window copies reach one vreg past the chunk
+    A_pad = _round_up(arena_i.shape[0] + _CB + 128, 128)
     arena_i = jnp.pad(arena_i, (0, A_pad - arena_i.shape[0]))
-    OUTD = _round_up(cap + _CB, 128)
+    OUTD = _round_up(cap + _CB + 128, 128)
 
     tab = pl.BlockSpec((1, n), lambda i: (0, 0))
     arena_spec = pl.BlockSpec((1, A_pad), lambda i: (0, 0))

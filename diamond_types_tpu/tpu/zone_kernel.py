@@ -775,6 +775,32 @@ def slice_tape_xs(tape: ZoneTape, slice_steps: int):
                 for k, v in xs_np.items()} for i in range(n_sl)]
 
 
+# Per-dispatch device-time budget for the sliced executor, in
+# step-replica-width units (scan_steps x batch x W). Calibrated on the
+# tunneled v5e runtime (2026-07-31): the runtime kills any single
+# program past a ~60 s device-time bound ("TPU worker process crashed
+# or restarted"); friendsforever at batch 8 (W 23,719) measured ~33M
+# units/s, so 3.3e8 units ~= 10 s/dispatch — a 6x margin under the kill
+# bound that also keeps liveness probes responsive between dispatches.
+_SLICE_BUDGET_UNITS = 3.3e8
+
+
+def auto_slice_steps(tape: "ZoneTape", batch: int) -> int:
+    """Slice length that bounds one dispatch's device time on the
+    tunneled runtime: scan steps per dispatch shrink as the replica
+    batch or the zone width W grow (per-step cost is ~linear in both —
+    every step does W-wide vector updates per replica)."""
+    units_per_step = max(1, int(batch)) * max(1, int(tape.W))
+    steps = int(_SLICE_BUDGET_UNITS // units_per_step)
+    # the budget takes precedence over the floor: a floor-clamped
+    # dispatch at flagship width (git-makefile W ~560k, batch 8) was
+    # measured at ~35 s with a 256 floor — inside 2x of the runtime's
+    # kill bound. 64 steps keeps the worst honored shape near the
+    # budget; dispatch-count growth is cheap (async enqueue, one
+    # compile for all slices).
+    return max(64, min(32768, steps))
+
+
 _zone_slice_jit_cache = {}
 
 

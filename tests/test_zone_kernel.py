@@ -187,3 +187,33 @@ def test_sliced_executor_matches_whole_tape(slice_steps):
         tape, prep.agent_k, prep.seq_k, 2, xs_slices=xs)
     assert np.array_equal(np.asarray(r1), np.asarray(r3))
     assert np.array_equal(np.asarray(e1), np.asarray(e3))
+
+
+def test_auto_slice_steps_bounds_dispatch_units():
+    """auto_slice_steps keeps scan_steps x batch x W inside the
+    per-dispatch device-time budget of the tunneled v5e runtime (which
+    kills any single program past ~60 s — root-caused 2026-07-31), with
+    a floor that keeps tiny slices from exploding dispatch counts."""
+    from types import SimpleNamespace
+    from diamond_types_tpu.tpu.zone_kernel import (auto_slice_steps,
+                                                   _SLICE_BUDGET_UNITS)
+
+    t = SimpleNamespace(W=23719)
+    s = auto_slice_steps(t, 8)
+    assert 256 <= s <= 32768
+    assert s * 8 * t.W <= _SLICE_BUDGET_UNITS
+    # batch growth shrinks the slice
+    assert auto_slice_steps(t, 8) <= auto_slice_steps(t, 1)
+    # width growth shrinks the slice
+    assert auto_slice_steps(SimpleNamespace(W=400_000), 8) <= s
+    # the budget takes precedence over the floor: flagship width at
+    # batch 8 (git-makefile W ~560k — a 256-step dispatch there
+    # measured ~35 s, inside 2x of the runtime's ~60 s kill bound)
+    # must land near the budget, not on a floor clamp above it
+    s_gm = auto_slice_steps(SimpleNamespace(W=560_000), 8)
+    assert s_gm * 8 * 560_000 <= _SLICE_BUDGET_UNITS
+    assert s_gm >= 64
+    # giant widths clamp at the floor instead of going to zero
+    assert auto_slice_steps(SimpleNamespace(W=10**9), 64) == 64
+    # tiny zones clamp at the whole-tape-friendly ceiling
+    assert auto_slice_steps(SimpleNamespace(W=1), 1) == 32768
