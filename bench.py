@@ -552,7 +552,28 @@ base = tuple(jnp.asarray(x[0])
              for x in (parent, side, kp, ka, ks, vis, off, chars))
 curve = {{}}
 best = None
+t_sweep0 = time.perf_counter()
+last_chunk_wall = 0.0
 for chunk in {chunks}:
+    # Window-budget guard: on the tunneled runtime the server-side AOT
+    # compile of a big-chunk program alone can exceed the whole bench
+    # budget (chunk 256 at cap 2^20 blew two 1500 s windows; the jax
+    # persistent cache does not apply to the remote-compile path), and
+    # a timeout strands the bench as a forever-retried partial. A chunk
+    # is attempted only while the remaining budget covers 4x the
+    # PREVIOUS chunk's whole wall (compile included — compile cost
+    # grows ~linearly with chunk, so 4x covers the next size up); the
+    # rest are skipped explicitly so the sweep COMPLETES, with the
+    # skip reason in the banked curve. The 60 s reserve covers the
+    # subprocess startup that predates t_sweep0's clock.
+    _remaining = {sweep_budget} - 60 - (time.perf_counter() - t_sweep0)
+    if best is not None and _remaining < 4 * last_chunk_wall:
+        curve[str(chunk)] = {{"skipped": "window budget: larger-chunk "
+                             "compile+run exceeds the remaining bench "
+                             "budget on this runtime"}}
+        print("JSONDATA", json.dumps({{"sweep": curve}}), flush=True)
+        continue
+    t_chunk0 = time.perf_counter()
     try:
         args = tuple(jnp.tile(x[None], (chunk,) + (1,) * x.ndim)
                      for x in base)
@@ -581,6 +602,7 @@ for chunk in {chunks}:
             best = (chunk, ops_s, dt)
     except Exception as e:
         curve[str(chunk)] = {{"error": str(e)[:120]}}
+    last_chunk_wall = time.perf_counter() - t_chunk0
     # cumulative progress: a timeout on a later chunk must not discard
     # the completed points (bench.py parses the LAST of each line kind;
     # flush so a timeout-kill can't drop a buffered error-only curve)
@@ -606,7 +628,7 @@ def bench_device_merge_sweep(corpus: str = "node_nodecc.dt",
     code = _MERGE_SWEEP_SNIPPET.format(
         repo=os.path.dirname(os.path.abspath(__file__)),
         data=os.path.join(BENCH_DATA, corpus), chunks=tuple(chunks),
-        liveness=LIVENESS_S)
+        liveness=LIVENESS_S, sweep_budget=timeout)
     return _run_device_bench_retry(code, timeout)
 
 
@@ -1020,7 +1042,8 @@ def _run_device_phase_locked(full: dict, probe: dict,
         out["tpu_merge_node_nodecc_best_chunk"] = int(r.get("best_chunk", 0))
         sweep = r.get("sweep", {})
         out["tpu_merge_batch_sweep"] = {
-            k: v.get("ops_per_sec", v.get("error", "?"))
+            k: v.get("ops_per_sec",
+                     v.get("error", v.get("skipped", "?")))
             for k, v in sweep.items()}
     else:
         out["tpu_merge_node_nodecc_sweep_error"] = _short_err(r)
