@@ -554,20 +554,41 @@ curve = {{}}
 best = None
 t_sweep0 = time.perf_counter()
 last_chunk_wall = 0.0
+last_chunk = 0          # last SUCCESSFUL chunk (predictor anchor)
+last_dt = 0.0           # its measured per-call seconds
 for chunk in {chunks}:
     # Window-budget guard: on the tunneled runtime the server-side AOT
     # compile of a big-chunk program alone can exceed the whole bench
     # budget (chunk 256 at cap 2^20 blew two 1500 s windows; the jax
     # persistent cache does not apply to the remote-compile path), and
-    # a timeout strands the bench as a forever-retried partial. A chunk
-    # is attempted only while the remaining budget covers 4x the
-    # PREVIOUS chunk's whole wall (compile included — compile cost
-    # grows ~linearly with chunk, so 4x covers the next size up); the
-    # rest are skipped explicitly so the sweep COMPLETES, with the
-    # skip reason in the banked curve. The 60 s reserve covers the
-    # subprocess startup that predates t_sweep0's clock.
+    # a timeout strands the bench as a forever-retried partial.
+    # Measured walls (2026-07-31, banked curve wall_s): chunk 8 = 6.3 s,
+    # chunk 64 = 78.2 s — compiles are cheap; per-call RUN time grows
+    # ~2x the chunk ratio (HBM-resident past ~8 docs: 1.09 s -> 17.8 s
+    # for 8x docs, predictor 17.5 s). Two guards, reasons banked in the
+    # curve:
+    #  * kill bound: the tunneled runtime kills any single program past
+    #    ~60 s of device time, so a chunk whose PREDICTED per-call
+    #    exceeds 55 s can never complete here (chunk 256 ~= 142 s burned
+    #    three 1500 s windows exactly this way);
+    #  * window budget: remaining budget must cover ~6 predicted calls
+    #    (warmup + validation fetch + 3 reps is ~5 call-scale
+    #    operations, plus compile margin). The 60 s reserve covers the
+    #    subprocess startup that predates t_sweep0's clock. The wall
+    #    fallback also fires when NO chunk has succeeded yet (an
+    #    errored chunk still updates last_chunk_wall) so a first-chunk
+    #    failure cannot leave the larger chunks unguarded.
     _remaining = {sweep_budget} - 60 - (time.perf_counter() - t_sweep0)
-    if best is not None and _remaining < 4 * last_chunk_wall:
+    _pred_call_s = (last_dt * 2.0 * (chunk / last_chunk)
+                    if last_chunk else 0.0)
+    if last_chunk and _pred_call_s > 55:
+        curve[str(chunk)] = {{"skipped": "kill bound: predicted "
+                             "%.0f s/call exceeds the runtime's ~60 s "
+                             "per-program limit" % _pred_call_s}}
+        print("JSONDATA", json.dumps({{"sweep": curve}}), flush=True)
+        continue
+    if (last_chunk or last_chunk_wall) and \\
+            _remaining < max(6 * _pred_call_s, 2.2 * last_chunk_wall):
         curve[str(chunk)] = {{"skipped": "window budget: larger-chunk "
                              "compile+run exceeds the remaining bench "
                              "budget on this runtime"}}
@@ -600,9 +621,13 @@ for chunk in {chunks}:
                               "validated_rows": len(rows)}}
         if best is None or ops_s > best[1]:
             best = (chunk, ops_s, dt)
+        last_chunk, last_dt = chunk, dt
     except Exception as e:
         curve[str(chunk)] = {{"error": str(e)[:120]}}
     last_chunk_wall = time.perf_counter() - t_chunk0
+    # wall includes this chunk's remote compile — recorded for guard
+    # calibration across runtimes
+    curve.setdefault(str(chunk), {{}})["wall_s"] = round(last_chunk_wall, 1)
     # cumulative progress: a timeout on a later chunk must not discard
     # the completed points (bench.py parses the LAST of each line kind;
     # flush so a timeout-kill can't drop a buffered error-only curve)
