@@ -1,0 +1,159 @@
+"""Device-session endurance soak — hours of realtime merge-per-edit
+traffic against the REAL chip, parity-checked against the host engine
+on every sync.
+
+The device benches measure per-call latency over seconds; this harness
+measures something they cannot: sustained runtime stability. It drives
+a `DeviceZoneSession` (tpu/zone_session.py) with the same 2-agent
+continuation shape as the session bench — each agent keeps typing from
+its own head — and asserts `sess.text() == oplog.checkout_tip()
+.snapshot()` after EVERY sync, so the device state, the sliced-resync
+path (capacity growth naturally forces full rebuilds as the document
+grows), and the micro-tape continuation are all parity-gated for the
+whole run. Worker crashes (the tunneled runtime's failure mode) are
+caught, logged, and recovered from by rebuilding the session; a parity
+MISMATCH is logged and stops the run (that is a correctness bug, not
+an environment event).
+
+Coexistence: pauses while an official `bench.py` run is in flight
+(same `.bench_active` mechanism as tools/soak.py) and does NOT hold
+the device lock — single probes from device_watcher.py interleave
+harmlessly between programs.
+
+Usage:
+  python -m diamond_types_tpu.tools.device_soak \
+      --corpus friendsforever.dt --hours 3 --log DEVICE_SOAK.jsonl
+Stop early: touch .stop_device_soak in the repo root.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+import traceback
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+_STOP = os.path.join(_REPO_ROOT, ".stop_device_soak")
+_BENCH_DATA = "/root/reference/benchmark_data"
+
+_bench_mod = []
+
+
+def _bench_is_active() -> bool:
+    if not _bench_mod:
+        try:
+            sys.path.insert(0, _REPO_ROOT)
+            import bench as _b
+            _bench_mod.append(_b)
+        except Exception:
+            _bench_mod.append(None)
+    if _bench_mod[0] is None:
+        return False
+    return _bench_mod[0].bench_is_active()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--corpus", default="friendsforever.dt")
+    p.add_argument("--hours", type=float, default=3.0)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--batch-max", type=int, default=8,
+                   help="max edits folded per sync")
+    p.add_argument("--log", default=None)
+    args = p.parse_args(argv)
+
+    out = open(args.log, "a") if args.log else sys.stdout
+
+    def emit(obj):
+        obj["ts"] = round(time.time(), 1)
+        out.write(json.dumps(obj, ensure_ascii=False) + "\n")
+        out.flush()
+
+    import jax
+    from ..encoding.decode import load_oplog
+    from ..tpu.zone_session import DeviceZoneSession
+
+    with open(os.path.join(_BENCH_DATA, args.corpus), "rb") as f:
+        ol = load_oplog(f.read())
+    emit({"event": "soak_start", "corpus": args.corpus,
+          "backend": jax.default_backend(), "hours": args.hours,
+          "n_ops_start": len(ol)})
+
+    rng = random.Random(args.seed)
+    t_build0 = time.time()
+    sess = DeviceZoneSession(ol)
+    sess.touch()
+    emit({"event": "session_built",
+          "build_s": round(time.time() - t_build0, 1)})
+
+    agents = list(range(len(ol.cg.agent_assignment.agent_names)))[:2]
+    heads = {a: [sess._agent_last_lv(a)] for a in agents}
+    lens = {a: len(ol.checkout(heads[a]).snapshot()) for a in agents}
+
+    def one_edit(a):
+        # inserts only: deletes at random positions are covered by the
+        # CI fuzz; growth is the POINT here (it forces capacity resyncs)
+        pos = rng.randrange(max(lens[a], 1))
+        n = rng.randint(1, 4)
+        heads[a] = [ol.add_insert_at(a, heads[a], pos, "q" * n)]
+        lens[a] += n
+
+    deadline = time.time() + args.hours * 3600
+    syncs = edits = crashes = 0
+    resyncs0 = sess.resyncs
+    t_report = time.time()
+    while time.time() < deadline and not os.path.exists(_STOP):
+        if _bench_is_active():
+            emit({"event": "paused", "why": "bench.py run in flight"})
+            time.sleep(30)
+            continue
+        k = rng.randint(1, args.batch_max)
+        for i in range(k):
+            one_edit(agents[(edits + i) % 2])
+        edits += k
+        try:
+            sess.sync()
+            got = sess.text()
+        except Exception:
+            crashes += 1
+            emit({"event": "device_crash", "crashes": crashes,
+                  "error": traceback.format_exc(limit=1)
+                  .strip().splitlines()[-1][:200]})
+            # recover: rebuild the whole session (exercises the sliced
+            # resync on the grown oplog) after a short settle
+            time.sleep(30)
+            try:
+                sess = DeviceZoneSession(ol)
+                sess.touch()
+                got = sess.text()
+            except Exception:
+                emit({"event": "recovery_failed", "fatal": True,
+                      "error": traceback.format_exc(limit=1)
+                      .strip().splitlines()[-1][:200]})
+                time.sleep(120)
+                continue
+        expected = ol.checkout_tip().snapshot()
+        if got != expected:
+            emit({"event": "PARITY_MISMATCH", "syncs": syncs,
+                  "edits": edits, "fatal": True})
+            return 1
+        syncs += 1
+        if time.time() - t_report > 120:
+            emit({"event": "progress", "syncs": syncs, "edits": edits,
+                  "resyncs": sess.resyncs - resyncs0, "crashes": crashes,
+                  "doc_chars": len(expected), "n_ops": len(ol),
+                  "elapsed_s": round(time.time() - (deadline -
+                                                    args.hours * 3600))})
+            t_report = time.time()
+    emit({"event": "soak_end", "syncs": syncs, "edits": edits,
+          "resyncs": sess.resyncs - resyncs0, "crashes": crashes,
+          "parity": "all syncs byte-identical", "n_ops_end": len(ol)})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,7 @@ whose xs arrive over time.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,8 +35,8 @@ from ..listmerge.compose import compose_entry
 from ..listmerge.zone_np import ZonePrep, prepare_zone
 from .merge_kernel import _pow2
 from .zone_kernel import (BIG32, OP_APPLY, OP_FORK, OP_MAX, ZoneTape,
-                          _pad_tape_xs, init_zone_carry, make_zone_step,
-                          pack_zone_tape)
+                          _pad_tape_xs, auto_slice_steps, init_zone_carry,
+                          make_zone_step, pack_zone_tape, slice_tape_xs)
 
 _sess_jit_cache = {}
 
@@ -162,15 +163,12 @@ class DeviceZoneSession:
 
         tape = pack_zone_tape(prep, self.MB, self.MC, self.MD)
         tape = self._retarget(tape, W_cap)
-        fn = _micro_fn(W_cap, prep.plen, n_rows, self.MB, self.MC,
-                       self.MD, _pow2(tape.op.shape[0]))
         carry = init_zone_carry(W_cap, prep.plen, n_rows, agent_k, seq_k)
         if self.row_sharding is not None:
             import jax
             carry = (jax.device_put(carry[0], self.row_sharding),) \
                 + tuple(carry[1:])
-        xs = {k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}
-        self.carry = fn(carry, xs)
+        self.carry = self._run_tape(carry, tape, n_rows)
 
         # row registry: pinned agent-head rows + their frontiers
         self.row_of: Dict[Tuple[int, ...], int] = {}
@@ -192,6 +190,39 @@ class DeviceZoneSession:
             self.carry = _tip_row_fn(self.W_cap, self.n_rows_eff)(
                 self.carry, r)
             self.row_of[tipkey] = r
+
+    def _run_tape(self, carry, tape: ZoneTape, n_rows: int):
+        """Execute `tape` on top of `carry`, with per-dispatch device
+        time bounded on tpu (auto_slice_steps — per-step cost is
+        ~linear in W x n_rows): the tunneled runtime kills any single
+        program past ~60 s, which a grown session's resync tape — or a
+        large sync() backlog (e.g. a bulk import appended onto a
+        tracked head) — would cross as one whole-tape program. Pad
+        steps are self-FORK no-ops, so the sliced and whole-tape paths
+        are bit-identical (pinned by tests via DT_SESSION_SLICE: a
+        positive value forces that slice length on any backend, 0
+        forces whole-tape; empty/unset picks the backend default)."""
+        import jax
+        import jax.numpy as jnp
+
+        sl_env = os.environ.get("DT_SESSION_SLICE")
+        if sl_env:
+            slice_steps = max(0, int(sl_env))
+        else:
+            slice_steps = (auto_slice_steps(tape, n_rows)
+                           if jax.default_backend() == "tpu" else 0)
+        T = tape.op.shape[0]
+        if slice_steps and slice_steps < _pow2(T):
+            S, xs_slices = slice_tape_xs(tape, slice_steps)
+            fn = _micro_fn(tape.W, tape.plen, n_rows, self.MB, self.MC,
+                           self.MD, S)
+            for xs in xs_slices:
+                carry = fn(carry, xs)
+            return carry
+        fn = _micro_fn(tape.W, tape.plen, n_rows, self.MB, self.MC,
+                       self.MD, _pow2(T))
+        xs = {k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}
+        return fn(carry, xs)
 
     def _take_row(self, exclude) -> Optional[int]:
         """A free state row, evicting the least-recently-used tracked
@@ -343,12 +374,8 @@ class DeviceZoneSession:
 
         if steps:
             tape = self._steps_to_tape(steps)
-            fn = _micro_fn(self.W_cap, self.plen, self.n_rows_eff,
-                           self.MB, self.MC, self.MD,
-                           _pow2(tape.op.shape[0]))
-            xs = {k: jnp.asarray(v)
-                  for k, v in _pad_tape_xs(tape).items()}
-            self.carry = fn(self.carry, xs)
+            self.carry = self._run_tape(self.carry, tape,
+                                        self.n_rows_eff)
             self.merges += 1
         self.synced_to = end
         return len(steps)

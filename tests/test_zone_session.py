@@ -132,3 +132,33 @@ def test_session_late_agent_resync():
     ol.add_insert_at(a, v, 2, "M")
     sess.sync()
     assert sess.text() == ol.checkout_tip().snapshot()
+
+
+def test_session_sliced_resync_matches_whole_tape(monkeypatch):
+    """A resync executed as bounded-length slices (DT_SESSION_SLICE — the
+    tpu default via auto_slice_steps, added because a grown session's
+    whole-tape rebuild would cross the tunneled runtime's ~60 s
+    per-program kill bound) is bit-identical to the whole-tape rebuild:
+    same text, same incremental behavior afterwards."""
+    rng = random.Random(9100)
+    ol = OpLog()
+    agents = [ol.get_or_create_agent_id(n) for n in ("ann", "bo")]
+    v, c = [], ""
+    for _ in range(12):
+        v, c = random_edit(rng, ol, agents[0], v, c)
+    heads = {a: (v, c) for a in agents}
+    for step in range(20):
+        a = agents[step % 2]
+        hv, hc = heads[a]
+        heads[a] = random_edit(rng, ol, a, hv, hc)
+
+    monkeypatch.setenv("DT_SESSION_SLICE", "7")   # uneven boundaries
+    sess = DeviceZoneSession(ol)
+    assert sess.text() == ol.checkout_tip().snapshot()
+    # incremental continuation on top of a sliced rebuild
+    for step in range(10):
+        a = agents[step % 2]
+        hv, hc = heads[a]
+        heads[a] = random_edit(rng, ol, a, hv, hc)
+        sess.sync()
+        assert sess.text() == ol.checkout_tip().snapshot()
