@@ -587,8 +587,13 @@ for chunk in {chunks}:
                              "per-program limit" % _pred_call_s}}
         print("JSONDATA", json.dumps({{"sweep": curve}}), flush=True)
         continue
+    # 120 s floor: early chunks finish in single-digit seconds, so the
+    # two scaled terms can both be tiny right when the NEXT chunk's
+    # remote AOT compile is about to cost minutes — a near-exhausted
+    # window would pass the scaled guard and blow the whole budget on
+    # one doomed compile.
     if (last_chunk or last_chunk_wall) and \\
-            _remaining < max(6 * _pred_call_s, 2.2 * last_chunk_wall):
+            _remaining < max(6 * _pred_call_s, 2.2 * last_chunk_wall, 120):
         curve[str(chunk)] = {{"skipped": "window budget: larger-chunk "
                              "compile+run exceeds the remaining bench "
                              "budget on this runtime"}}
@@ -764,6 +769,27 @@ def bench_codec(name: str):
     n = len(ol)
     return {"decode_ops_per_sec": round(n / t_dec),
             "encode_ops_per_sec": round(n / t_enc)}
+
+
+def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
+                      engine: str = "device", timeout: int = 300):
+    """Sharded multi-document merge scheduler (serve/): replays the
+    synthetic trace across `docs` docs on `shards` CPU-simulated shards
+    through the router + shape-bucketed admission queue + per-shard
+    session banks, byte-parity-gated per doc against the single-engine
+    host checkout. Runs as a subprocess: the CLI pins JAX_PLATFORMS=cpu
+    itself, so a wedged accelerator tunnel can never stall the host
+    phase, and the jit caches it warms die with the child."""
+    cmd = [sys.executable, "-m", "diamond_types_tpu.tools.cli",
+           "serve-bench", "--shards", str(shards), "--docs", str(docs),
+           "--txns", str(txns), "--engine", engine, "--json"]
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=timeout,
+                       cwd=os.path.dirname(os.path.abspath(__file__)))
+    if p.returncode != 0:
+        raise RuntimeError(f"serve-bench rc={p.returncode}: "
+                           f"{(p.stderr or p.stdout)[-200:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
 
 
 def _timed(fn):
@@ -1321,6 +1347,24 @@ def _main() -> None:
             extra[f"{key}_codec"] = bench_codec(corpus)
         except Exception as e:  # pragma: no cover
             extra[f"{key}_codec_error"] = str(e)[:120]
+
+    # Sharded multi-doc serve scheduler (serve/ tier): device-engine
+    # sessions on CPU-simulated shards, parity-gated per doc. Summary
+    # keeps the capacity-planning signals; the full report keeps the
+    # whole metrics snapshot (per-shard rows, flush histogram).
+    try:
+        sv = bench_serve_sched()
+        full["serve_sched"] = sv
+        m = sv["metrics"]
+        extra["serve_sched"] = {
+            "ops_per_sec": sv["ops_per_sec"],
+            "parity": sv["parity_ok"],
+            "batch_occupancy": m["batch_occupancy"],
+            "queue_bound_violations": m["queue_bound_violations"],
+            "host_fallback_ratio": m["host_fallback_ratio"],
+        }
+    except Exception as e:  # pragma: no cover
+        extra["serve_sched_error"] = str(e)[:120]
 
     # Peak-memory probe (reference: examples/posstats.rs behind the
     # memusage feature / trace-alloc counting allocator). Python-side

@@ -23,7 +23,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:                       # jax >= 0.4.38 exports it at top level
+    from jax import shard_map
+except ImportError:        # pragma: no cover - version-dependent path
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tpu.batch import replay_batch
@@ -34,6 +37,18 @@ def make_mesh(n_devices: int | None = None, axis: str = "docs") -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def serve_shard_devices(n_shards: int):
+    """Device placement for the serve/ scheduler's shard banks: shard i
+    lives on devices[i % n_devices]. With fewer devices than shards the
+    assignment wraps (several logical shards share a chip — the CPU
+    simulation path, where conftest/driver force a virtual host device
+    count). Each SessionBank then builds and steps its sessions under
+    `jax.default_device(...)` of its own device, so per-shard work is
+    genuinely placed, not just labeled."""
+    devs = jax.devices()
+    return [devs[i % len(devs)] for i in range(n_shards)]
 
 
 def sharded_replay(mesh: Mesh, pos, dlen, ilen, chars, cap: int):

@@ -1,0 +1,145 @@
+"""Shape-bucketed admission queues with bounded depth + backpressure.
+
+The device tier amortizes dispatch overhead only when work of one padded
+shape is flushed together (the zone session's jit cache is keyed on the
+padded micro-tape length; a flush whose docs share a bucket shares one
+compiled program). Pending merges are therefore bucketed by the
+next-power-of-two of their pending op count and flushed when EITHER
+trigger fires (Just-in-Time Dynamic Batching, arxiv 1904.07421):
+
+  * size     — a bucket reached `flush_docs` distinct documents;
+  * deadline — the bucket's OLDEST entry has waited `flush_deadline_s`
+               (latency bound: a lone doc is never starved by the size
+               trigger).
+
+Depth is bounded per shard. A submit that would push a shard past
+`max_pending` pending DOCUMENTS raises `Backpressure` with a
+`retry_after` hint instead of growing the queue — the caller (HTTP
+handler, bench driver) surfaces it as a 429-style reject-with-retry.
+Re-submitting a doc that is already queued never adds depth: the
+pending entry coalesces (its op count accumulates; it may migrate to a
+larger shape bucket; its deadline clock keeps the ORIGINAL enqueue time
+so coalescing cannot starve the deadline trigger).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+def shape_bucket(n_ops: int) -> int:
+    """Next power of two >= n_ops (minimum 1) — the padded shape class."""
+    n = max(int(n_ops), 1)
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass
+class PendingMerge:
+    doc_id: str
+    n_ops: int
+    enqueued_at: float
+
+
+class Backpressure(Exception):
+    """Shard queue is full; retry after `retry_after` seconds."""
+
+    def __init__(self, shard: int, depth: int, retry_after: float) -> None:
+        self.shard = shard
+        self.depth = depth
+        self.retry_after = retry_after
+        super().__init__(
+            f"shard {shard} admission queue full ({depth} pending); "
+            f"retry after {retry_after:.3f}s")
+
+
+class AdmissionQueue:
+    def __init__(self, n_shards: int, max_pending: int = 256,
+                 flush_docs: int = 8,
+                 flush_deadline_s: float = 0.05) -> None:
+        if max_pending < 1 or flush_docs < 1:
+            raise ValueError("max_pending and flush_docs must be >= 1")
+        self.n_shards = n_shards
+        self.max_pending = max_pending
+        self.flush_docs = flush_docs
+        self.flush_deadline_s = flush_deadline_s
+        # shard -> bucket -> doc_id -> PendingMerge (dict = FIFO order)
+        self._q: List[Dict[int, Dict[str, PendingMerge]]] = [
+            {} for _ in range(n_shards)]
+        self._where: List[Dict[str, int]] = [{} for _ in range(n_shards)]
+
+    # ---- intake ----------------------------------------------------------
+
+    def depth(self, shard: int) -> int:
+        return len(self._where[shard])
+
+    def pending_bucket(self, shard: int, doc_id: str) -> Optional[int]:
+        """The shape bucket `doc_id` is queued under, or None."""
+        return self._where[shard].get(doc_id)
+
+    def total_depth(self) -> int:
+        return sum(len(w) for w in self._where)
+
+    def submit(self, shard: int, doc_id: str, n_ops: int,
+               now: float) -> int:
+        """Queue (or coalesce) `n_ops` of pending merge work for
+        `doc_id`. Returns the shape bucket it landed in. Raises
+        Backpressure instead of exceeding `max_pending` docs/shard."""
+        where = self._where[shard]
+        old_bucket = where.get(doc_id)
+        if old_bucket is not None:
+            item = self._q[shard][old_bucket].pop(doc_id)
+            item.n_ops += max(int(n_ops), 0)
+            bucket = shape_bucket(item.n_ops)
+            self._q[shard].setdefault(bucket, {})[doc_id] = item
+            where[doc_id] = bucket
+            return bucket
+        if len(where) >= self.max_pending:
+            # the deadline trigger drains the oldest bucket within one
+            # deadline window; that is the honest earliest retry time
+            raise Backpressure(shard, len(where), self.flush_deadline_s)
+        bucket = shape_bucket(n_ops)
+        self._q[shard].setdefault(bucket, {})[doc_id] = PendingMerge(
+            doc_id, max(int(n_ops), 1), now)
+        where[doc_id] = bucket
+        return bucket
+
+    # ---- flush triggers --------------------------------------------------
+
+    def due(self, now: float,
+            force: bool = False) -> List[Tuple[int, int, str]]:
+        """(shard, bucket, reason) for every bucket whose size or
+        deadline trigger fired (every non-empty bucket when `force`)."""
+        out: List[Tuple[int, int, str]] = []
+        for shard in range(self.n_shards):
+            for bucket, docs in self._q[shard].items():
+                if not docs:
+                    continue
+                if force:
+                    out.append((shard, bucket, "force"))
+                elif len(docs) >= self.flush_docs:
+                    out.append((shard, bucket, "size"))
+                else:
+                    oldest = next(iter(docs.values()))
+                    if now - oldest.enqueued_at >= self.flush_deadline_s:
+                        out.append((shard, bucket, "deadline"))
+        return out
+
+    def take(self, shard: int, bucket: int,
+             limit: Optional[int] = None) -> List[PendingMerge]:
+        """Dequeue up to `limit` (default `flush_docs`) docs from one
+        bucket, FIFO."""
+        docs = self._q[shard].get(bucket)
+        if not docs:
+            return []
+        k = limit if limit is not None else self.flush_docs
+        out = []
+        for doc_id in list(docs)[:k]:
+            out.append(docs.pop(doc_id))
+            del self._where[shard][doc_id]
+        if not docs:
+            del self._q[shard][bucket]
+        return out

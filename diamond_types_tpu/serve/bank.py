@@ -1,0 +1,183 @@
+"""Per-shard session bank: LRU-bounded device residency + host fallback.
+
+One bank per shard owns every device-resident `DeviceZoneSession` placed
+on that shard's chip. Residency is bounded two ways, mirroring the
+eviction/resync machinery the multichip dryrun proved out
+(`__graft_entry__._dryrun_session_sharded`):
+
+  * `max_sessions` — at most N documents resident at once;
+  * `max_slots`    — total device-slot footprint (sum of each session's
+                     `footprint_slots()`, dominated by the W_cap x
+                     n_rows state matrix) stays under a VMEM-shaped
+                     budget. A session that GROWS past the budget on
+                     resync evicts its least-recently-used neighbors.
+
+Eviction drops the device carry; the document itself lives in its host
+OpLog, so an evicted doc costs one rebuild (resync) on its next merge —
+graceful degradation, exactly like the session's internal row LRU.
+
+Every sync is parity-recoverable: if the device path raises (worker
+crash, capacity corner), the bank evicts the broken session, serves the
+merge from the host engine (`oplog.checkout_tip()` — always correct)
+and counts a host fallback. `engine="host"` forces that path for every
+doc: the scheduler then still provides routing/batching/metrics, which
+is what the HTTP server uses (first-touch JAX init against a wedged
+accelerator tunnel must never hang a request handler).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from .metrics import ServeMetrics
+
+
+class _HostDoc:
+    """Host-engine stand-in for a device session: the oplog IS the
+    state, so sync is a no-op and text is a tracker checkout."""
+
+    resyncs = 0
+
+    def __init__(self, oplog) -> None:
+        self.oplog = oplog
+        self.synced_to = len(oplog)
+
+    def sync(self) -> int:
+        new = len(self.oplog) - self.synced_to
+        self.synced_to = len(self.oplog)
+        return max(new, 0)
+
+    def text(self) -> str:
+        return self.oplog.checkout_tip().snapshot()
+
+    def footprint_slots(self) -> int:
+        return 0
+
+
+class SessionBank:
+    def __init__(self, shard_id: int, max_sessions: int = 8,
+                 max_slots: int = 1 << 24, engine: str = "device",
+                 device=None, metrics: Optional[ServeMetrics] = None,
+                 session_opts: Optional[dict] = None) -> None:
+        if engine not in ("device", "host"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.shard_id = shard_id
+        self.max_sessions = max(int(max_sessions), 1)
+        self.max_slots = int(max_slots)
+        self.engine = engine
+        self.device = device
+        self.metrics = metrics
+        self.session_opts = dict(session_opts or {})
+        self.sessions: "OrderedDict[str, object]" = OrderedDict()
+        self._resyncs_seen: Dict[str, int] = {}
+
+    # ---- accounting ------------------------------------------------------
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.bump(self.shard_id, key, n)
+
+    def footprint_slots(self) -> int:
+        return sum(s.footprint_slots() for s in self.sessions.values())
+
+    def _evict_until_fits(self, incoming_slots: int = 0,
+                          keep: Optional[str] = None) -> None:
+        def over() -> bool:
+            return (len(self.sessions) > self.max_sessions or
+                    self.footprint_slots() + incoming_slots
+                    > self.max_slots)
+        while self.sessions and over():
+            victim = next((k for k in self.sessions if k != keep), None)
+            if victim is None:
+                break      # only `keep` is resident; nothing to evict
+            self.sessions.pop(victim)
+            self._resyncs_seen.pop(victim, None)
+            self._bump("evictions")
+
+    def evict(self, doc_id: str) -> bool:
+        if self.sessions.pop(doc_id, None) is not None:
+            self._resyncs_seen.pop(doc_id, None)
+            self._bump("evictions")
+            return True
+        return False
+
+    # ---- residency -------------------------------------------------------
+
+    def _build(self, doc_id: str, oplog):
+        if self.engine == "host":
+            return _HostDoc(oplog)
+        from ..tpu.zone_session import DeviceZoneSession
+        if self.device is not None:
+            import jax
+            with jax.default_device(self.device):
+                sess = DeviceZoneSession(oplog, **self.session_opts)
+        else:
+            sess = DeviceZoneSession(oplog, **self.session_opts)
+        # the initial build counts as this doc's baseline, not a resync
+        self._resyncs_seen[doc_id] = getattr(sess, "resyncs", 0)
+        return sess
+
+    def session(self, doc_id: str, oplog):
+        """Get-or-build the doc's resident session, updating LRU order
+        and enforcing both residency bounds."""
+        sess = self.sessions.get(doc_id)
+        if sess is not None:
+            self.sessions.move_to_end(doc_id)
+            return sess
+        # make room BEFORE the expensive build (the new session's exact
+        # footprint is unknown until built; re-check after)
+        self._evict_until_fits()
+        sess = self._build(doc_id, oplog)
+        self._bump("builds")
+        self.sessions[doc_id] = sess
+        self._evict_until_fits(keep=doc_id)
+        if self.metrics is not None:
+            self.metrics.observe_footprint(self.shard_id,
+                                           self.footprint_slots())
+        return sess
+
+    # ---- merge path ------------------------------------------------------
+
+    def sync_doc(self, doc_id: str, oplog) -> dict:
+        """Fold the doc's appended ops into its shard-resident state.
+        Never raises for device failures: falls back to the host engine
+        and records the fallback."""
+        self._bump("syncs")
+        try:
+            sess = self.session(doc_id, oplog)
+            if self.device is not None and self.engine == "device":
+                import jax
+                with jax.default_device(self.device):
+                    steps = sess.sync()
+            else:
+                steps = sess.sync()
+            seen = self._resyncs_seen.get(doc_id)
+            now_resyncs = getattr(sess, "resyncs", 0)
+            if seen is not None and now_resyncs > seen:
+                self._bump("resyncs", now_resyncs - seen)
+                self._resyncs_seen[doc_id] = now_resyncs
+            if self.metrics is not None:
+                self.metrics.observe_footprint(self.shard_id,
+                                               self.footprint_slots())
+            return {"engine": self.engine, "steps": int(steps)}
+        except Exception as e:
+            if self.engine == "host":
+                raise       # host checkouts failing is a real bug
+            self.evict(doc_id)
+            self._bump("host_fallbacks")
+            return {"engine": "host", "steps": _HostDoc(oplog).sync(),
+                    "error": f"{e.__class__.__name__}: {e}"[:200]}
+
+    def text(self, doc_id: str, oplog) -> str:
+        """Merged text for the doc — from the resident session when one
+        exists (device parity surface), host checkout otherwise."""
+        sess = self.sessions.get(doc_id)
+        if sess is None:
+            return oplog.checkout_tip().snapshot()
+        if getattr(sess, "synced_to", 0) < len(oplog):
+            self.sync_doc(doc_id, oplog)
+            sess = self.sessions.get(doc_id)
+            if sess is None:     # sync fell back + evicted
+                return oplog.checkout_tip().snapshot()
+        return sess.text()

@@ -1,0 +1,209 @@
+"""Workload driver for the merge scheduler (cli `serve-bench`).
+
+Replays a trace corpus through a MergeScheduler on N simulated shards
+and byte-parity-gates every document against the single-engine merge —
+this is what makes the multi-chip path WORKLOAD-DRIVEN instead of
+dryrun-only. Two workload shapes:
+
+  * trace      — every doc replays the same editing trace (the
+                 reference's crdt-testdata JSON format, text/trace.py),
+                 linear single-agent history. All docs share padded
+                 shapes, so the whole fleet shares one jit cache entry
+                 per micro-tape length — the shape-bucketing payoff in
+                 its purest form.
+  * concurrent — per doc, two agents keep typing from their OWN heads
+                 (the realtime shape device_soak drives). The
+                 (agent, length) schedule is shared across docs — same
+                 shapes again — while positions derive from a per-doc
+                 rng, so content and merge order genuinely differ.
+
+Parity: for engine="device" the scheduler's answer comes from the zone
+kernel's device state (DeviceZoneSession.text()) while the single-engine
+result is the host tracker checkout — two independent engines, compared
+byte-for-byte per document. Runs on CPU (JAX_PLATFORMS=cpu + virtual
+devices); a real mesh only changes placement, not the code path.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..text.oplog import OpLog
+from ..text.trace import TestData, load_trace
+from .scheduler import MergeScheduler
+
+
+def synth_trace(n_txns: int = 40, ops_per_txn: int = 3,
+                seed: int = 7) -> TestData:
+    """Deterministic typing-shaped trace (inserts with occasional
+    deletes) in the crdt-testdata format — the fallback corpus when no
+    trace file is given."""
+    rng = random.Random(seed)
+    doc: List[str] = []
+    txns: List[List[Tuple[int, int, str]]] = []
+    for _ in range(n_txns):
+        txn: List[Tuple[int, int, str]] = []
+        for _ in range(ops_per_txn):
+            if doc and rng.random() < 0.25:
+                pos = rng.randrange(len(doc))
+                n = min(rng.randint(1, 3), len(doc) - pos)
+                txn.append((pos, n, ""))
+                del doc[pos:pos + n]
+            else:
+                pos = rng.randint(0, len(doc))
+                s = "".join(rng.choice("abcdefgh ")
+                            for _ in range(rng.randint(1, 4)))
+                txn.append((pos, 0, s))
+                doc[pos:pos] = list(s)
+        txns.append(txn)
+    return TestData(start_content="", end_content="".join(doc),
+                    txns=txns)
+
+
+def _trace_feeders(data: TestData, doc_ids: List[str]):
+    """Per-doc generators: each yield applies one txn to the doc's oplog
+    (linear append, like replay_into_oplog) and reports its op count."""
+    def feeder(ol: OpLog):
+        agent = ol.get_or_create_agent_id("trace")
+        for txn in data.txns:
+            n = 0
+            for (pos, num_del, ins) in txn:
+                if num_del:
+                    ol.add_delete_without_content(agent, pos,
+                                                  pos + num_del)
+                    n += 1
+                if ins:
+                    ol.add_insert(agent, pos, ins)
+                    n += 1
+            yield n
+    return {d: feeder for d in doc_ids}
+
+
+def _concurrent_schedule(rounds: int, edits_per_round: int,
+                         seed: int) -> List[List[Tuple[int, int]]]:
+    """(agent_idx, insert_len) per edit, SHARED across docs so their
+    session shapes coincide (positions stay per-doc)."""
+    rng = random.Random(seed)
+    return [[(e % 2, rng.randint(1, 4))
+             for e in range(edits_per_round)]
+            for _ in range(rounds)]
+
+
+def _concurrent_feeders(schedule, doc_ids: List[str], seed: int):
+    def make_feeder(doc_idx: int):
+        def feeder(ol: OpLog):
+            rng = random.Random(seed * 7919 + doc_idx)
+            agents = [ol.get_or_create_agent_id(n)
+                      for n in ("ca", "cb")]
+            heads: Dict[int, list] = {0: [], 1: []}
+            lens = {0: 0, 1: 0}
+            for round_edits in schedule:
+                for (ai, n) in round_edits:
+                    pos = rng.randrange(max(lens[ai], 1)) \
+                        if lens[ai] else 0
+                    ch = chr(ord("a") + (doc_idx % 26))
+                    heads[ai] = [ol.add_insert_at(
+                        agents[ai], heads[ai], pos, ch * n)]
+                    lens[ai] += n
+                yield len(round_edits)
+        return feeder
+    return {d: make_feeder(i) for i, d in enumerate(doc_ids)}
+
+
+def run_serve_bench(shards: int = 4, docs: int = 8,
+                    txns: Optional[int] = None, engine: str = "device",
+                    mode: str = "trace", corpus: Optional[str] = None,
+                    flush_docs: int = 4, flush_deadline_s: float = 0.02,
+                    max_pending: int = 64, max_sessions: int = 4,
+                    seed: int = 7, place_on_devices: bool = True,
+                    session_opts: Optional[dict] = None) -> dict:
+    """Replay the workload through a fresh scheduler; returns a JSON-able
+    report with throughput, the metrics snapshot, and the parity gate."""
+    doc_ids = [f"doc{i:03d}" for i in range(docs)]
+    ols: Dict[str, OpLog] = {}
+    for d in doc_ids:
+        ol = OpLog()
+        ol.doc_id = d
+        ols[d] = ol
+
+    if mode == "trace":
+        data = load_trace(corpus) if corpus else \
+            synth_trace(n_txns=txns or 40, seed=seed)
+        if txns:
+            data = TestData(start_content=data.start_content,
+                            end_content=data.end_content,
+                            txns=data.txns[:txns])
+        feeders = {d: f(ols[d])
+                   for d, f in _trace_feeders(data, doc_ids).items()}
+        n_rounds = len(data.txns)
+    elif mode == "concurrent":
+        n_rounds = txns or 24
+        schedule = _concurrent_schedule(n_rounds, 2, seed)
+        feeders = {d: f(ols[d]) for d, f in
+                   _concurrent_feeders(schedule, doc_ids, seed).items()}
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    sched = MergeScheduler(
+        shards, resolve=ols.__getitem__, engine=engine,
+        max_sessions_per_shard=max_sessions,
+        max_pending=max_pending, flush_docs=flush_docs,
+        flush_deadline_s=flush_deadline_s,
+        place_on_devices=place_on_devices, session_opts=session_opts)
+
+    t0 = time.perf_counter()
+    total_ops = 0
+    retries = 0
+    live = dict(feeders)
+    while live:
+        done = []
+        for d, gen in live.items():
+            try:
+                n = next(gen)
+            except StopIteration:
+                done.append(d)
+                continue
+            total_ops += n
+            r = sched.submit(d, n_ops=n)
+            attempts = 0
+            while not r["accepted"]:
+                # reject-with-retry-after: flush due work and retry; a
+                # couple of polite retries, then force a flush so the
+                # feed loop always terminates
+                retries += 1
+                attempts += 1
+                sched.pump(force=attempts > 2)
+                r = sched.submit(d, n_ops=n)
+        for d in done:
+            del live[d]
+        sched.pump()
+    sched.drain()
+    feed_wall = time.perf_counter() - t0
+
+    mismatches = []
+    for d in doc_ids:
+        want = ols[d].checkout_tip().snapshot()
+        got = sched.text(d)
+        if got != want:
+            mismatches.append(d)
+    wall = time.perf_counter() - t0
+
+    report = {
+        "config": {"shards": shards, "docs": docs, "engine": engine,
+                   "mode": mode, "corpus": corpus,
+                   "rounds": n_rounds, "flush_docs": flush_docs,
+                   "flush_deadline_s": flush_deadline_s,
+                   "max_pending": max_pending,
+                   "max_sessions": max_sessions, "seed": seed},
+        "total_ops": total_ops,
+        "submit_retries": retries,
+        "feed_wall_s": round(feed_wall, 3),
+        "wall_s": round(wall, 3),
+        "ops_per_sec": round(total_ops / max(feed_wall, 1e-9)),
+        "parity_ok": not mismatches,
+        "parity_mismatches": mismatches,
+        "metrics": sched.metrics_json(),
+    }
+    return report

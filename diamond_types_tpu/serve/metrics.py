@@ -1,0 +1,101 @@
+"""JSON-exportable scheduler metrics.
+
+One plain-counter surface shared by bench.py, the soak tools and the
+sync server's /metrics endpoint. Everything here is host-side Python
+ints/floats — recording a sample never touches the device, so the
+metrics path can run inside flush loops without perturbing timings.
+
+Schema (snapshot()):
+
+  {"shards": N, "flush_docs": B,
+   "totals": {"submits", "coalesced", "rejects", "flushes",
+              "flushed_docs", "flushed_ops", "builds", "evictions",
+              "resyncs", "syncs", "host_fallbacks"},
+   "batch_occupancy": mean(flush size) / flush_docs,   # 0..1
+   "host_fallback_ratio": host_fallbacks / max(syncs, 1),
+   "flush_reasons": {"size": n, "deadline": n, "force": n},
+   "flush_size_hist": {"1": n, "2": n, ...},
+   "max_depth_seen": d,
+   "queue_bound_violations": 0,     # depth observed above max_pending
+   "per_shard": [{"shard", "queue_depth", "submits", "rejects",
+                  "flushes", "flushed_docs", "builds", "evictions",
+                  "resyncs", "host_fallbacks", "footprint_slots"}, ...]}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+_SHARD_KEYS = ("submits", "coalesced", "rejects", "flushes",
+               "flushed_docs", "flushed_ops", "builds", "evictions",
+               "resyncs", "syncs", "host_fallbacks")
+
+
+class ServeMetrics:
+    def __init__(self, n_shards: int, flush_docs: int,
+                 max_pending: int) -> None:
+        self.n_shards = n_shards
+        self.flush_docs = flush_docs
+        self.max_pending = max_pending
+        self.shard: List[Dict[str, int]] = [
+            {k: 0 for k in _SHARD_KEYS} for _ in range(n_shards)]
+        self.flush_reasons: Dict[str, int] = {}
+        self.flush_size_hist: Dict[int, int] = {}
+        self.max_depth_seen = 0
+        self.queue_bound_violations = 0
+        self.queue_depth: List[int] = [0] * n_shards
+        self.footprint_slots: List[int] = [0] * n_shards
+
+    # ---- recording -------------------------------------------------------
+
+    def bump(self, shard: int, key: str, n: int = 1) -> None:
+        self.shard[shard][key] += n
+
+    def record_flush(self, shard: int, n_docs: int, n_ops: int,
+                     reason: str) -> None:
+        c = self.shard[shard]
+        c["flushes"] += 1
+        c["flushed_docs"] += n_docs
+        c["flushed_ops"] += n_ops
+        self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
+        self.flush_size_hist[n_docs] = \
+            self.flush_size_hist.get(n_docs, 0) + 1
+
+    def observe_queue(self, shard: int, depth: int) -> None:
+        self.queue_depth[shard] = depth
+        if depth > self.max_depth_seen:
+            self.max_depth_seen = depth
+        if depth > self.max_pending:
+            # must stay 0: the bounded-queue contract (admission raises
+            # Backpressure before this point); nonzero = a real bug
+            self.queue_bound_violations += 1
+
+    def observe_footprint(self, shard: int, slots: int) -> None:
+        self.footprint_slots[shard] = int(slots)
+
+    # ---- export ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        totals = {k: sum(s[k] for s in self.shard) for k in _SHARD_KEYS}
+        flushes = max(totals["flushes"], 1)
+        occupancy = (totals["flushed_docs"] / flushes) / self.flush_docs
+        return {
+            "shards": self.n_shards,
+            "flush_docs": self.flush_docs,
+            "max_pending": self.max_pending,
+            "totals": totals,
+            "batch_occupancy": round(occupancy, 4),
+            "host_fallback_ratio": round(
+                totals["host_fallbacks"] / max(totals["syncs"], 1), 4),
+            "flush_reasons": dict(self.flush_reasons),
+            "flush_size_hist": {str(k): v for k, v in
+                                sorted(self.flush_size_hist.items())},
+            "max_depth_seen": self.max_depth_seen,
+            "queue_bound_violations": self.queue_bound_violations,
+            "per_shard": [
+                {"shard": i, "queue_depth": self.queue_depth[i],
+                 "footprint_slots": self.footprint_slots[i],
+                 **self.shard[i]}
+                for i in range(self.n_shards)],
+        }

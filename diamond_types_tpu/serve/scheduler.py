@@ -1,0 +1,205 @@
+"""The multi-document merge scheduler: router x admission x banks.
+
+Sits between the sync server's DocStore and the device tier. A document
+edit lands as `submit(doc_id, n_ops)`; the scheduler routes it to its
+shard, coalesces it into a shape bucket, and `pump()` flushes due
+buckets into the shard's session bank — one flush drives every doc in
+the bucket back-to-back on that shard's chip, so they share the padded
+micro-tape shape (and therefore the jit cache entry) instead of each
+paying its own compile.
+
+Threading: a single lock guards the queue + banks. The intended callers
+are (a) HTTP handler threads submitting, (b) ONE pump thread flushing
+(`start_pump`), and (c) bench drivers doing both inline. Device work
+runs while holding the lock — by design, since one chip per shard can
+only run one program at a time anyway; submits during a flush simply
+queue for the next pump.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .admission import AdmissionQueue, Backpressure
+from .bank import SessionBank
+from .metrics import ServeMetrics
+from .router import ShardRouter
+
+
+class MergeScheduler:
+    def __init__(self, n_shards: int,
+                 resolve: Callable[[str], object],
+                 engine: str = "device",
+                 max_sessions_per_shard: int = 8,
+                 max_slots_per_shard: int = 1 << 24,
+                 max_pending: int = 256,
+                 flush_docs: int = 8,
+                 flush_deadline_s: float = 0.05,
+                 place_on_devices: bool = False,
+                 session_opts: Optional[dict] = None,
+                 sync_lock=None) -> None:
+        """`resolve(doc_id) -> OpLog` is the document authority —
+        DocStore.get fits directly. `sync_lock` (e.g. DocStore.lock) is
+        held around each doc's sync so bank reads never race handler
+        threads mutating the oplog; `resolve` is always called OUTSIDE
+        it (DocStore.get takes that same non-reentrant lock)."""
+        self.resolve = resolve
+        self._sync_lock = sync_lock if sync_lock is not None \
+            else contextlib.nullcontext()
+        self.router = ShardRouter(n_shards)
+        self.queue = AdmissionQueue(n_shards, max_pending=max_pending,
+                                    flush_docs=flush_docs,
+                                    flush_deadline_s=flush_deadline_s)
+        self.metrics = ServeMetrics(n_shards, flush_docs, max_pending)
+        devices: List = [None] * n_shards
+        if place_on_devices and engine == "device":
+            from ..parallel.mesh import serve_shard_devices
+            devices = serve_shard_devices(n_shards)
+        self.banks = [
+            SessionBank(i, max_sessions=max_sessions_per_shard,
+                        max_slots=max_slots_per_shard, engine=engine,
+                        device=devices[i], metrics=self.metrics,
+                        session_opts=session_opts)
+            for i in range(n_shards)]
+        self.lock = threading.Lock()
+        self._pump_stop = threading.Event()
+        self._pump_thread: Optional[threading.Thread] = None
+
+    # ---- intake ----------------------------------------------------------
+
+    def submit(self, doc_id: str, n_ops: int = 1,
+               now: Optional[float] = None) -> dict:
+        """Queue pending merge work. Returns {"accepted": True, "shard",
+        "bucket"} or {"accepted": False, "retry_after"} on backpressure
+        (never raises — rejects are normal operation under load)."""
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            shard = self.router.assign(doc_id)
+            self.metrics.bump(shard, "submits")
+            already = self.queue.pending_bucket(shard, doc_id) is not None
+            try:
+                bucket = self.queue.submit(shard, doc_id, n_ops, now)
+            except Backpressure as bp:
+                self.metrics.bump(shard, "rejects")
+                return {"accepted": False, "shard": shard,
+                        "retry_after": bp.retry_after}
+            if already:
+                self.metrics.bump(shard, "coalesced")
+            self.metrics.observe_queue(shard, self.queue.depth(shard))
+            return {"accepted": True, "shard": shard, "bucket": bucket}
+
+    # ---- flush -----------------------------------------------------------
+
+    def pump(self, now: Optional[float] = None,
+             force: bool = False) -> int:
+        """Flush every due bucket. Returns the number of docs synced."""
+        now = time.monotonic() if now is None else now
+        synced = 0
+        with self.lock:
+            for shard, bucket, reason in self.queue.due(now, force=force):
+                items = self.queue.take(shard, bucket)
+                if not items:
+                    continue
+                bank = self.banks[shard]
+                for item in items:
+                    ol = self.resolve(item.doc_id)
+                    with self._sync_lock:
+                        bank.sync_doc(item.doc_id, ol)
+                    synced += 1
+                self.metrics.record_flush(
+                    shard, len(items), sum(i.n_ops for i in items),
+                    reason)
+                self.metrics.observe_queue(shard,
+                                           self.queue.depth(shard))
+        return synced
+
+    def drain(self) -> int:
+        """Flush everything regardless of triggers (shutdown, rebalance,
+        parity checks)."""
+        total = 0
+        while self.queue.total_depth():
+            n = self.pump(force=True)
+            if n == 0:
+                break     # defensive: a take() returning nothing
+            total += n
+        return total
+
+    # ---- reads / control -------------------------------------------------
+
+    def text(self, doc_id: str) -> str:
+        """Merged text from the doc's shard (device-resident state when
+        present). Pending queued work for the doc is flushed first so
+        the answer reflects every accepted submit."""
+        with self.lock:
+            shard = self.router.assign(doc_id)
+            bucket = self.queue.pending_bucket(shard, doc_id)
+            if bucket is not None:
+                # flush the doc's whole bucket (its neighbors share the
+                # shape anyway), counted as a read-triggered flush
+                items = self.queue.take(shard, bucket,
+                                        limit=self.queue.max_pending)
+                bank = self.banks[shard]
+                for item in items:
+                    ol = self.resolve(item.doc_id)
+                    with self._sync_lock:
+                        bank.sync_doc(item.doc_id, ol)
+                self.metrics.record_flush(
+                    shard, len(items), sum(i.n_ops for i in items),
+                    "read")
+                self.metrics.observe_queue(shard,
+                                           self.queue.depth(shard))
+            ol = self.resolve(doc_id)
+            with self._sync_lock:
+                return self.banks[shard].text(doc_id, ol)
+
+    def rebalance(self, n_shards: int) -> Dict[str, tuple]:
+        """Shrink (or restore) the live shard count: drain pending work,
+        re-route, and evict moved docs' sessions from their OLD shards
+        (they rebuild on the new shard at next merge). Growing past the
+        constructed bank count needs a new scheduler — banks hold device
+        placement decided at construction."""
+        if n_shards > len(self.banks):
+            raise ValueError(
+                f"cannot grow past the constructed {len(self.banks)} "
+                "shards; build a new MergeScheduler")
+        self.drain()
+        with self.lock:
+            moved = self.router.rebalance(n_shards)
+            for doc_id, (old, _new) in moved.items():
+                self.banks[old].evict(doc_id)
+            return moved
+
+    def metrics_json(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["router_counts"] = self.router.counts()
+        return snap
+
+    # ---- background pump -------------------------------------------------
+
+    def start_pump(self, interval_s: Optional[float] = None) -> None:
+        if self._pump_thread is not None:
+            return
+        interval = interval_s if interval_s is not None else \
+            max(self.queue.flush_deadline_s / 2, 0.01)
+
+        def loop():
+            while not self._pump_stop.wait(interval):
+                try:
+                    self.pump()
+                except Exception:       # pragma: no cover - keep pumping
+                    pass
+
+        self._pump_thread = threading.Thread(target=loop, daemon=True)
+        self._pump_thread.start()
+
+    def stop_pump(self, drain: bool = True) -> None:
+        self._pump_stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2)
+            self._pump_thread = None
+        self._pump_stop = threading.Event()
+        if drain:
+            self.drain()

@@ -219,6 +219,57 @@ def cmd_git_import(args) -> int:
     return 0
 
 
+def cmd_serve_bench(args) -> int:
+    """Replay a trace corpus through the serve/ merge scheduler on N
+    simulated shards, byte-parity-gated against the single-engine merge
+    (see serve/driver.py). Exits nonzero on any parity mismatch."""
+    if not args.real_device:
+        # simulated shards: pin the CPU platform BEFORE any backend
+        # init and force a virtual device count covering the shards
+        # (same discipline as __graft_entry__.dryrun_multichip — the
+        # site hooks can otherwise block on a wedged accelerator tunnel)
+        import re
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{max(args.shards, 2)}").strip()
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
+    from ..serve.driver import run_serve_bench
+    kw = dict(shards=args.shards, docs=args.docs, txns=args.txns,
+              engine=args.engine, mode=args.mode, corpus=args.corpus,
+              flush_docs=args.flush_docs,
+              flush_deadline_s=args.flush_deadline,
+              max_pending=args.max_pending,
+              max_sessions=args.max_sessions, seed=args.seed)
+    if args.dry_run:
+        # CI smoke preset: host engine, tiny workload, no jax needed
+        kw.update(shards=2, docs=4, txns=6, engine="host",
+                  place_on_devices=False)
+    report = run_serve_bench(**kw)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        m = report["metrics"]
+        print(f"serve-bench: {report['config']['docs']} docs / "
+              f"{report['config']['shards']} shards "
+              f"({report['config']['engine']} engine, "
+              f"{report['config']['mode']} mode): "
+              f"{report['total_ops']} ops in {report['wall_s']}s "
+              f"({report['ops_per_sec']} ops/s), "
+              f"occupancy {m['batch_occupancy']}, "
+              f"parity {'OK' if report['parity_ok'] else 'MISMATCH'}")
+    return 0 if report["parity_ok"] else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dt-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -269,6 +320,33 @@ def main(argv=None) -> int:
     c.add_argument("--repo", help="git repo root (default .)")
     c.add_argument("--out", required=True, help="output .dt file")
     c.set_defaults(fn=cmd_git_import)
+
+    c = sub.add_parser(
+        "serve-bench",
+        help="replay a workload through the sharded merge scheduler")
+    c.add_argument("--shards", type=int, default=4)
+    c.add_argument("--docs", type=int, default=8)
+    c.add_argument("--txns", type=int, default=None,
+                   help="rounds to replay (default: whole corpus)")
+    c.add_argument("--engine", choices=("device", "host"),
+                   default="device")
+    c.add_argument("--mode", choices=("trace", "concurrent"),
+                   default="trace")
+    c.add_argument("--corpus", help="crdt-testdata JSON trace file "
+                   "(default: synthetic trace)")
+    c.add_argument("--flush-docs", type=int, default=4)
+    c.add_argument("--flush-deadline", type=float, default=0.02)
+    c.add_argument("--max-pending", type=int, default=64)
+    c.add_argument("--max-sessions", type=int, default=4)
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--json", action="store_true",
+                   help="print the full JSON report")
+    c.add_argument("--metrics-out", help="write the JSON report here")
+    c.add_argument("--dry-run", action="store_true",
+                   help="tiny host-engine smoke preset (CI)")
+    c.add_argument("--real-device", action="store_true",
+                   help="skip the CPU-simulation env pinning")
+    c.set_defaults(fn=cmd_serve_bench)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -63,6 +63,9 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7)
     p.add_argument("--batch-max", type=int, default=8,
                    help="max edits folded per sync")
+    p.add_argument("--max-recovery-failures", type=int, default=5,
+                   help="bail out after this many CONSECUTIVE failed "
+                   "session rebuilds (the runtime is gone, not flaky)")
     p.add_argument("--log", default=None)
     args = p.parse_args(argv)
 
@@ -90,8 +93,29 @@ def main(argv=None) -> int:
     emit({"event": "session_built",
           "build_s": round(time.time() - t_build0, 1)})
 
-    agents = list(range(len(ol.cg.agent_assignment.agent_names)))[:2]
-    heads = {a: [sess._agent_last_lv(a)] for a in agents}
+    # The 2-agent continuation shape needs two agents that each OWN at
+    # least one op: heads come from _agent_last_lv, and a None head
+    # (agent registered but opless, or a single-agent linear corpus)
+    # would crash the first one_edit with a useless traceback hours
+    # into an unattended run. Validate up front; a missing SECOND
+    # agent is repairable by seeding one op at the tip.
+    agents = [a for a in range(len(ol.cg.agent_assignment.agent_names))
+              if sess._agent_last_lv(a) is not None][:2]
+    if not agents:
+        emit({"event": "soak_abort", "fatal": True,
+              "why": f"corpus {args.corpus} has no agent with any ops; "
+              "cannot derive an editing head (pick a non-empty corpus)"})
+        return 1
+    heads = {}
+    if len(agents) == 1:
+        a2 = ol.get_or_create_agent_id("device-soak-2")
+        heads[a2] = [ol.add_insert_at(a2, list(ol.version), 0, "q")]
+        agents.append(a2)
+        emit({"event": "seeded_second_agent", "agent": "device-soak-2",
+              "why": "corpus has a single editing agent; the soak's "
+              "continuation shape needs two concurrent heads"})
+    for a in agents:
+        heads.setdefault(a, [sess._agent_last_lv(a)])
     lens = {a: len(ol.checkout(heads[a]).snapshot()) for a in agents}
 
     def one_edit(a):
@@ -104,6 +128,8 @@ def main(argv=None) -> int:
 
     deadline = time.time() + args.hours * 3600
     syncs = edits = crashes = 0
+    recovery_failures = 0
+    recovering = False
     resyncs0 = sess.resyncs
     t_report = time.time()
     while time.time() < deadline and not os.path.exists(_STOP):
@@ -111,30 +137,53 @@ def main(argv=None) -> int:
             emit({"event": "paused", "why": "bench.py run in flight"})
             time.sleep(30)
             continue
-        k = rng.randint(1, args.batch_max)
-        for i in range(k):
-            one_edit(agents[(edits + i) % 2])
-        edits += k
-        try:
-            sess.sync()
-            got = sess.text()
-        except Exception:
-            crashes += 1
-            emit({"event": "device_crash", "crashes": crashes,
-                  "error": traceback.format_exc(limit=1)
-                  .strip().splitlines()[-1][:200]})
-            # recover: rebuild the whole session (exercises the sliced
-            # resync on the grown oplog) after a short settle
-            time.sleep(30)
+        if recovering:
+            # Rebuild WITHOUT appending new edits: every failed rebuild
+            # would otherwise grow the oplog, making each retry strictly
+            # harder than the last (and the backlog meaningless). Bail
+            # once the failures are consecutive enough to mean "the
+            # runtime is gone", not "the runtime blipped".
             try:
                 sess = DeviceZoneSession(ol)
                 sess.touch()
                 got = sess.text()
             except Exception:
-                emit({"event": "recovery_failed", "fatal": True,
+                recovery_failures += 1
+                emit({"event": "recovery_failed",
+                      "consecutive": recovery_failures,
+                      "max": args.max_recovery_failures,
                       "error": traceback.format_exc(limit=1)
                       .strip().splitlines()[-1][:200]})
+                if recovery_failures >= args.max_recovery_failures:
+                    emit({"event": "soak_abort", "fatal": True,
+                          "why": f"{recovery_failures} consecutive "
+                          "session rebuilds failed; giving up",
+                          "syncs": syncs, "edits": edits,
+                          "crashes": crashes})
+                    return 2
                 time.sleep(120)
+                continue
+            recovering = False
+            recovery_failures = 0
+            emit({"event": "recovered", "syncs": syncs, "edits": edits})
+        else:
+            k = rng.randint(1, args.batch_max)
+            for i in range(k):
+                one_edit(agents[(edits + i) % 2])
+            edits += k
+            try:
+                sess.sync()
+                got = sess.text()
+            except Exception:
+                crashes += 1
+                emit({"event": "device_crash", "crashes": crashes,
+                      "error": traceback.format_exc(limit=1)
+                      .strip().splitlines()[-1][:200]})
+                # recover: rebuild the whole session (exercises the
+                # sliced resync on the grown oplog) after a settle; the
+                # recovery loop above owns the retries
+                time.sleep(30)
+                recovering = True
                 continue
         expected = ol.checkout_tip().snapshot()
         if got != expected:

@@ -39,6 +39,9 @@ web_assets.py for the pages):
                             equivalent: the reference wiki server streams
                             patches to subscribed clients)
   GET  /doc/{id}/graph      -> causal DAG runs JSON (visualizer data)
+  GET  /metrics             -> {"serve": scheduler metrics | null} —
+                            the sharded merge scheduler's counters when
+                            the server runs with --serve-shards N
   POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
   POST /doc/{id}/history    body {"n": k} -> {"snapshots": [{"lv",
                             "text"}...]} oldest-first history strip; with
@@ -86,6 +89,11 @@ class DocStore:
         # can't spam stderr and burn O(doc) encode work on every flush
         # pass forever (ADVICE r4)
         self.flush_failures: Dict[str, int] = {}
+        # Optional sharded merge scheduler (serve/): when attached, every
+        # accepted mutation also queues device-merge work for the doc's
+        # shard; its pump thread keeps the session banks warm so reads
+        # can come off pre-merged state instead of a cold checkout.
+        self.scheduler = None
         self.lock = threading.Lock()
         self.io_lock = threading.Lock()   # serializes flush passes
         # Long-poll wakeups (one condition per doc; notified on new ops).
@@ -115,6 +123,25 @@ class DocStore:
         if self._flusher is not None:
             self._flusher.join(timeout=2)
             self._flusher = None
+
+    def attach_scheduler(self, scheduler) -> None:
+        """Wire a serve.MergeScheduler built with resolve=self.get and
+        sync_lock=self.lock (so bank syncs never race handler threads)."""
+        self.scheduler = scheduler
+
+    def submit_merge(self, doc_id: str, n_ops: int = 1):
+        """Queue merge work for the doc's shard. No-op (returns None)
+        when no scheduler is attached. Backpressure rejects are the
+        scheduler's problem, not the edit's: the edit is already durably
+        in the oplog, so a rejected submit only delays warm state — the
+        next accepted submit or a read-triggered flush catches it up.
+        MUST be called OUTSIDE self.lock (the pump thread takes
+        scheduler.lock then self.lock; a caller holding self.lock here
+        would invert that order and deadlock)."""
+        sched = self.scheduler
+        if sched is None:
+            return None
+        return sched.submit(doc_id, n_ops=n_ops)
 
     def cond(self, doc_id: str) -> threading.Condition:
         with self.lock:
@@ -488,6 +515,13 @@ class SyncHandler(BaseHTTPRequestHandler):
         if self.path == "/" or self.path == "":
             return self._send(200, INDEX_HTML.encode("utf8"),
                               "text/html; charset=utf-8")
+        if self.path == "/metrics":
+            # serve/ scheduler counters (queue depths, flush sizes,
+            # occupancy, evictions...) — JSON for bench/soak scrapers
+            sched = self.store.scheduler
+            body = json.dumps(
+                {"serve": sched.metrics_json() if sched else None})
+            return self._send(200, body.encode("utf8"))
         if len(parts) == 2 and parts[0] in ("edit", "vis", "crdt"):
             if not _DOC_ID_RE.match(parts[1]):
                 return self._send(404, b"{}")
@@ -571,7 +605,9 @@ class SyncHandler(BaseHTTPRequestHandler):
                 return self._send(400, b'{"error": "bad agent name"}')
             with self.store.lock:
                 pre = list(ol.version)
+                pre_len = len(ol)
                 decode_into(ol, body)
+                n_new = len(ol) - pre_len
                 # Does folding the pushed ops into the pre-push document
                 # actually collide (concurrent inserts at one gap)?
                 # Surfaced so clients can flag ambiguous merges
@@ -589,6 +625,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     collisions = None
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
+            if n_new:
+                self.store.submit_merge(doc_id, n_new)
             return self._send(200, json.dumps(
                 {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
@@ -640,6 +678,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                 out = ol.cg.local_to_remote_frontier(frontier)
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
+            self.store.submit_merge(doc_id, len(ops))
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
         if action == "changes":
@@ -706,6 +745,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                     # (both helpers take store.lock themselves)
                     self.store.mark_dirty(doc_id)
                     self.store.notify(doc_id)
+                    self.store.submit_merge(doc_id, applied)
             return self._send(200, json.dumps(
                 {"ops": out_ops, "version": ver}).encode("utf8"))
         if action == "history":
@@ -750,14 +790,28 @@ class _Server(ThreadingHTTPServer):
 
     def server_close(self):  # final flush on clean shutdown
         if self.store is not None:
+            if self.store.scheduler is not None:
+                self.store.scheduler.stop_pump(drain=True)
             self.store.stop_flusher()
             self.store.flush(force=True)
         super().server_close()
 
 
-def serve(port: int = 8008, data_dir: Optional[str] = None
-          ) -> ThreadingHTTPServer:
+def serve(port: int = 8008, data_dir: Optional[str] = None,
+          serve_shards: int = 0) -> ThreadingHTTPServer:
     store = DocStore(data_dir)
+    if serve_shards:
+        # engine="host" on purpose: this process serves HTTP, and
+        # first-touch JAX backend init against a wedged accelerator
+        # tunnel would hang every handler (same rationale as
+        # doc_history_strip's device gate). The scheduler still
+        # exercises the full route/queue/flush/evict machinery; flip to
+        # engine="device" only in a process that owns its chips.
+        from ..serve.scheduler import MergeScheduler
+        sched = MergeScheduler(serve_shards, resolve=store.get,
+                               engine="host", sync_lock=store.lock)
+        store.attach_scheduler(sched)
+        sched.start_pump()
     handler = type("Handler", (SyncHandler,), {"store": store})
     httpd = _Server(("127.0.0.1", port), handler)
     httpd.store = store
@@ -814,8 +868,11 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=8008)
     p.add_argument("--data-dir", default=None)
+    p.add_argument("--serve-shards", type=int, default=0,
+                   help="enable the sharded merge scheduler with N "
+                   "host-engine shards (0 = off); metrics at /metrics")
     args = p.parse_args()
-    httpd = serve(args.port, args.data_dir)
+    httpd = serve(args.port, args.data_dir, serve_shards=args.serve_shards)
     print(f"serving on http://127.0.0.1:{args.port}")
     httpd.serve_forever()
 

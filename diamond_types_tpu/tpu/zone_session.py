@@ -426,3 +426,11 @@ class DeviceZoneSession:
         """Force completion of pending device work with a tiny transfer
         (per-merge latency benches time sync()+touch())."""
         return np.asarray(self.carry[7])   # m: a scalar
+
+    def footprint_slots(self) -> int:
+        """Device-residency cost of this session in int32 slots, for the
+        serve/ bank's capacity accounting: the state matrix dominates
+        (n_rows x W_cap), plus the per-slot planes (rank, order, origin
+        ids x2, ever, agent key, seq key — 7 more W_cap vectors). Host
+        pool/key tables are not counted; the budget models the chip."""
+        return int(self.W_cap) * (int(self.n_rows_eff) + 7)
