@@ -1,0 +1,37 @@
+"""Cross-host replication: peer mesh, doc-ownership leases, anti-entropy.
+
+The serve/ scheduler made one process own many documents across many
+chips; this package makes N *processes* (sync-server instances) jointly
+own the document space. The wire format is the one the single server
+already speaks — version summaries (`causalgraph/summary.py`) plus v1
+binary patches — reused verbatim for inter-server anti-entropy, so a
+peer is just another sync client with a lease protocol on top.
+
+Layers (each its own module, composed by `node.ReplicaNode`):
+
+  peers.py        static peer table, health probes, consecutive-failure
+                  circuit breaker, jittered exponential `Backoff`,
+                  timeout on every HTTP call
+  ownership.py    doc-ownership leases on top of rendezvous placement
+                  extended to hosts (same blake2b scheme as
+                  serve/router.py) with an explicit handoff protocol
+  antientropy.py  background reconciliation: summary exchange + binary
+                  patch pull/push for divergent docs
+  faults.py       deterministic fault injection (drop / delay /
+                  duplicate / partition by seed) for tests + soak
+  metrics.py      replication counters merged into `GET /metrics`
+  node.py         ReplicaNode — wires the above to a DocStore
+  soak.py         in-process N-server soak driver (`cli replicate-soak`)
+"""
+
+from .faults import FaultDrop, FaultInjector
+from .metrics import ReplicationMetrics
+from .node import ReplicaNode, attach_replication
+from .ownership import LeaseManager, owner_of
+from .peers import Backoff, CircuitOpen, PeerTable, call_with_retries
+
+__all__ = [
+    "Backoff", "CircuitOpen", "FaultDrop", "FaultInjector",
+    "LeaseManager", "PeerTable", "ReplicaNode", "ReplicationMetrics",
+    "attach_replication", "call_with_retries", "owner_of",
+]

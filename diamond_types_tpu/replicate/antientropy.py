@@ -1,0 +1,168 @@
+"""Anti-entropy: background convergence between peer servers.
+
+Each round, for each healthy peer, exchange doc lists and version
+summaries (`summarize_versions` / `intersect_with_summary` — the exact
+handshake `SyncClient` already speaks) and move v1 binary patches for
+divergent docs:
+
+  * pull — the peer has ops we lack (`intersect_with_summary` returned
+    a remainder): POST our summary to its `/doc/{id}/pull`, decode the
+    patch into the local oplog;
+  * push — we have ops past the common frontier: encode a patch from
+    `common` and POST it to the peer's `/doc/{id}/push` (symmetric, so
+    one round converges a pair instead of waiting for the peer's own
+    pull pass).
+
+Ownership is irrelevant here on purpose: NON-owners converge too, so a
+dead owner's docs are recoverable — the rendezvous successor already
+holds the bytes when it takes the lease over. Scheduler merge work
+stays owner-only via the admit gate; a pulled patch on a non-owner just
+lands in the oplog (host state), no device merge.
+
+Doc-list responses piggyback lease claims, which keeps every host's
+lease view fresh without a separate gossip channel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+from typing import Dict, List, Optional
+
+from ..causalgraph.summary import intersect_with_summary, \
+    summarize_versions
+from ..encoding.decode import decode_into
+from ..encoding.encode import ENCODE_PATCH, encode_oplog
+
+
+class AntiEntropy:
+    def __init__(self, node, interval_s: float = 0.5, push: bool = True,
+                 max_docs_per_round: Optional[int] = None) -> None:
+        self.node = node                  # ReplicaNode (duck-typed)
+        self.interval_s = interval_s
+        self.push = push
+        self.max_docs_per_round = max_docs_per_round
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- one round -------------------------------------------------------
+
+    def run_round(self, peer_id: Optional[str] = None) -> dict:
+        """Reconcile with one peer (or every currently-healthy peer).
+        Never raises: per-doc failures are counted and the round moves
+        on — a flaky link degrades convergence speed, not the loop."""
+        node = self.node
+        peers = [peer_id] if peer_id is not None \
+            else [p for p in node.table.peer_ids()
+                  if node.table.is_healthy(p)]
+        report = {"peers": {}, "pulled": 0, "pushed": 0, "errors": 0}
+        for p in peers:
+            rep = self._round_with(p)
+            report["peers"][p] = rep
+            report["pulled"] += rep["pulled"]
+            report["pushed"] += rep["pushed"]
+            report["errors"] += rep["errors"]
+        node.metrics.bump("antientropy", "rounds")
+        return report
+
+    def _round_with(self, peer_id: str) -> dict:
+        node = self.node
+        rep = {"docs": 0, "pulled": 0, "pushed": 0, "errors": 0}
+        try:
+            listing = node.table.call_json(peer_id, "/replicate/docs")
+        except (OSError, urllib.error.HTTPError):
+            node.metrics.bump("antientropy", "errors")
+            rep["errors"] += 1
+            return rep
+        remote_docs = listing.get("docs") or {}
+        # piggybacked lease claims keep the lease view fresh
+        for doc_id, info in remote_docs.items():
+            lease = (info or {}).get("lease")
+            if lease:
+                node.leases.observe_remote(
+                    doc_id, lease["holder"], int(lease["epoch"]),
+                    lease.get("state", "active"),
+                    float(lease.get("ttl_s", 0.0)))
+        doc_ids = sorted(set(remote_docs) | set(node.store.doc_ids()))
+        if self.max_docs_per_round is not None:
+            doc_ids = doc_ids[:self.max_docs_per_round]
+        for doc_id in doc_ids:
+            try:
+                r = self._reconcile_doc(peer_id, doc_id)
+                rep["docs"] += 1
+                rep["pulled"] += r["pulled"]
+                rep["pushed"] += r["pushed"]
+            except (OSError, ValueError, KeyError,
+                    urllib.error.HTTPError):
+                node.metrics.bump("antientropy", "errors")
+                rep["errors"] += 1
+        return rep
+
+    def _reconcile_doc(self, peer_id: str, doc_id: str) -> dict:
+        """Summary handshake + patch exchange for one doc."""
+        import json
+        node = self.node
+        store = node.store
+        node.metrics.bump("antientropy", "docs_checked")
+        remote_summary = node.table.call_json(
+            peer_id, f"/doc/{doc_id}/summary")
+        ol = store.get(doc_id)
+        with store.lock:
+            common, remainder = intersect_with_summary(
+                ol.cg, remote_summary)
+            local_summary = summarize_versions(ol.cg)
+            # anything of ours past the common frontier, the peer lacks
+            push_patch = None
+            if self.push and sorted(common) != sorted(ol.version):
+                push_patch = encode_oplog(ol, ENCODE_PATCH,
+                                          from_version=common)
+        out = {"pulled": 0, "pushed": 0}
+        if remainder:
+            _st, patch = node.table.call(
+                peer_id, f"/doc/{doc_id}/pull",
+                data=json.dumps(local_summary).encode("utf8"))
+            with store.lock:
+                pre_len = len(ol)
+                decode_into(ol, patch)
+                n_new = len(ol) - pre_len
+            node.metrics.bump("antientropy", "docs_pulled")
+            node.metrics.bump("antientropy", "bytes_pulled", len(patch))
+            out["pulled"] = 1
+            if n_new:
+                store.mark_dirty(doc_id)
+                store.notify(doc_id)
+                # owner-gated: on a non-owner the admit gate denies and
+                # the ops stay host-side until the lease moves here
+                store.submit_merge(doc_id, n_new)
+        if push_patch is not None:
+            node.table.call(peer_id, f"/doc/{doc_id}/push",
+                            data=push_patch)
+            node.metrics.bump("antientropy", "docs_pushed")
+            node.metrics.bump("antientropy", "bytes_pushed",
+                              len(push_patch))
+            out["pushed"] = 1
+        return out
+
+    # ---- background loop -------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.run_round()
+                except Exception:    # pragma: no cover - keep running
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self._stop = threading.Event()

@@ -1,0 +1,98 @@
+"""Deterministic fault injection for the peer mesh.
+
+Every inter-server HTTP call funnels through `PeerTable.call`, which
+consults one shared `FaultInjector` before touching the network. Tests
+and the `cli replicate-soak` driver inject drops, delays, duplicates
+and partitions from a fixed seed, so a failing convergence run replays
+byte-for-byte.
+
+Determinism contract: outcomes are drawn from one `random.Random(seed)`
+in call order. Drive the mesh single-threaded (tests call
+`probe_once()` / `run_round()` inline) and the fault schedule is exact;
+under the threaded soak driver it is still seed-stable per interleaving.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, FrozenSet, Set
+
+
+class FaultDrop(ConnectionError):
+    """An injected drop — indistinguishable from a connection failure to
+    the caller, on purpose: the retry/circuit machinery must treat
+    injected and real faults identically."""
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0,
+                 dup_rate: float = 0.0, delay_rate: float = 0.0,
+                 max_delay_s: float = 0.0) -> None:
+        self.rng = random.Random(seed)
+        self.drop_rate = drop_rate
+        self.dup_rate = dup_rate
+        self.delay_rate = delay_rate
+        self.max_delay_s = max_delay_s
+        self._partitions: Set[FrozenSet[str]] = set()
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "drops": 0, "delays": 0, "dups": 0, "partition_blocks": 0}
+
+    # ---- partitions ------------------------------------------------------
+
+    def partition(self, a: str, b: str) -> None:
+        """Cut the (bidirectional) link between peers `a` and `b`."""
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str = None, b: str = None) -> None:
+        """Heal one link (both args) or every partition (no args)."""
+        with self._lock:
+            if a is None:
+                self._partitions.clear()
+            else:
+                self._partitions.discard(frozenset((a, b)))
+
+    def partitioned(self, a: str, b: str) -> bool:
+        with self._lock:
+            return frozenset((a, b)) in self._partitions
+
+    # ---- call-site hook --------------------------------------------------
+
+    def before_call(self, src: str, dst: str) -> bool:
+        """Run the fault schedule for one outbound call. Raises
+        `FaultDrop` for a drop/partition, sleeps for a delay, and
+        returns True when the call should be DUPLICATED (sent twice;
+        peer endpoints are idempotent, so dups must be harmless)."""
+        if self.partitioned(src, dst):
+            with self._lock:
+                self.counters["partition_blocks"] += 1
+            raise FaultDrop(f"partitioned: {src} <-> {dst}")
+        with self._lock:
+            # one rng draw per configured fault class, in fixed order,
+            # so enabling delays does not shift the drop schedule
+            drop = self.drop_rate and self.rng.random() < self.drop_rate
+            delay = (self.delay_rate
+                     and self.rng.random() < self.delay_rate)
+            dup = self.dup_rate and self.rng.random() < self.dup_rate
+            delay_s = (self.rng.random() * self.max_delay_s
+                       if delay else 0.0)
+            if drop:
+                self.counters["drops"] += 1
+            elif delay:
+                self.counters["delays"] += 1
+            if not drop and dup:
+                self.counters["dups"] += 1
+        if drop:
+            raise FaultDrop(f"injected drop: {src} -> {dst}")
+        if delay_s:
+            time.sleep(delay_s)
+        return bool(not drop and dup)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"partitions": sorted(
+                        tuple(sorted(p)) for p in self._partitions),
+                    **self.counters}

@@ -1,0 +1,87 @@
+"""Replication counters, merged into the sync server's `GET /metrics`.
+
+Same philosophy as serve/metrics.py: plain host-side ints behind one
+small lock, recording never touches the network or the device. The
+snapshot carries a `version` field so soak/bench scrapers can detect
+counter-set changes across PRs.
+
+Schema (snapshot()):
+
+  {"version": 1, "self": "host:port",
+   "leases": {"held", "acquires", "renewals", "takeovers", "releases",
+              "churn"},             # churn = acquires+takeovers+releases
+   "handoffs": {"started", "completed", "failed",
+                "latency_s_total", "latency_s_max"},
+   "antientropy": {"rounds", "docs_checked", "docs_pulled",
+                   "docs_pushed", "bytes_pulled", "bytes_pushed",
+                   "errors"},
+   "proxy": {"proxied", "fallback_local", "loops_refused"},
+   "merge_gate": {"admits", "denials"},
+   "probes": {"ok", "failed", "circuit_opens", "circuit_closes"},
+   "per_peer": {peer_id: {"consecutive_failures", "circuit_open",
+                          "backoff_s", "last_ok_age_s"}},
+   "faults": injector counters | null}
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_GROUPS = {
+    "leases": ("acquires", "renewals", "takeovers", "releases"),
+    "handoffs": ("started", "completed", "failed"),
+    "antientropy": ("rounds", "docs_checked", "docs_pulled",
+                    "docs_pushed", "bytes_pulled", "bytes_pushed",
+                    "errors"),
+    "proxy": ("proxied", "fallback_local", "loops_refused"),
+    "merge_gate": ("admits", "denials"),
+    "probes": ("ok", "failed", "circuit_opens", "circuit_closes"),
+}
+
+
+class ReplicationMetrics:
+    SCHEMA_VERSION = 1
+
+    def __init__(self, self_id: str = "") -> None:
+        self.self_id = self_id
+        self._lock = threading.Lock()
+        self._c: Dict[str, Dict[str, int]] = {
+            g: {k: 0 for k in keys} for g, keys in _GROUPS.items()}
+        self._handoff_latency_total = 0.0
+        self._handoff_latency_max = 0.0
+
+    def bump(self, group: str, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[group][key] += n
+
+    def observe_handoff_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._handoff_latency_total += seconds
+            if seconds > self._handoff_latency_max:
+                self._handoff_latency_max = seconds
+
+    def snapshot(self, leases_held: int = 0, per_peer: dict = None,
+                 faults: dict = None) -> dict:
+        with self._lock:
+            leases = dict(self._c["leases"])
+            leases["held"] = leases_held
+            leases["churn"] = (leases["acquires"] + leases["takeovers"]
+                               + leases["releases"])
+            handoffs = dict(self._c["handoffs"])
+            handoffs["latency_s_total"] = round(
+                self._handoff_latency_total, 6)
+            handoffs["latency_s_max"] = round(
+                self._handoff_latency_max, 6)
+            return {
+                "version": self.SCHEMA_VERSION,
+                "self": self.self_id,
+                "leases": leases,
+                "handoffs": handoffs,
+                "antientropy": dict(self._c["antientropy"]),
+                "proxy": dict(self._c["proxy"]),
+                "merge_gate": dict(self._c["merge_gate"]),
+                "probes": dict(self._c["probes"]),
+                "per_peer": per_peer or {},
+                "faults": faults,
+            }

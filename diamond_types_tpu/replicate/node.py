@@ -1,0 +1,301 @@
+"""ReplicaNode: one server's membership in the replication mesh.
+
+Composes the peer table (health), lease manager (ownership), and
+anti-entropy loop (convergence) around a DocStore, and implements the
+two protocols the HTTP tier delegates to it:
+
+  * mutation routing — `route_mutation(doc_id)` names the host that
+    should apply a write (current lease holder when known and healthy,
+    rendezvous owner otherwise); `proxy()` forwards the raw request
+    body there. When the target is unreachable the server falls back
+    to accepting locally (availability over placement — the edit lands
+    in the local oplog, anti-entropy reconciles it later, and the
+    merge gate keeps device work off this host);
+
+  * handoff — `handoff(doc_id, new_owner)` drives the sender side of
+    the lease state machine (see ownership.py):
+    grant → drain pending merges → final patch transfer → activate.
+
+`maintain()` is the periodic control step (piggybacked on the probe
+loop): renew held leases and hand off docs whose rendezvous owner moved
+(peer recovered, health view changed).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+from typing import List, Optional, Set, Tuple
+
+from ..causalgraph.summary import intersect_with_summary
+from ..encoding.encode import ENCODE_PATCH, encode_oplog
+from .antientropy import AntiEntropy
+from .faults import FaultInjector
+from .metrics import ReplicationMetrics
+from .ownership import DRAINING, TRANSFER, LeaseManager, owner_of
+from .peers import PeerTable
+
+MUTATION_ACTIONS = ("push", "edit", "ops")
+
+
+class ReplicaNode:
+    def __init__(self, store, self_id: str, peer_addrs: List[str],
+                 seed: int = 0, lease_ttl_s: float = 2.0,
+                 probe_interval_s: float = 0.5,
+                 antientropy_interval_s: float = 0.5,
+                 timeout_s: float = 2.0, fail_threshold: int = 3,
+                 backoff_base_s: float = 0.1,
+                 backoff_cap_s: float = 5.0,
+                 takeover_after_s: Optional[float] = None,
+                 faults: Optional[FaultInjector] = None) -> None:
+        self.store = store
+        self.self_id = self_id
+        self.started_at = time.monotonic()
+        # how long a peer must stay continuously down before ownership
+        # reassigns its docs; defaults to the lease TTL so a takeover
+        # can only happen after the old holder's lease has expired
+        self.takeover_after_s = (lease_ttl_s if takeover_after_s is None
+                                 else takeover_after_s)
+        self.metrics = ReplicationMetrics(self_id)
+        self.faults = faults
+        self.table = PeerTable(self_id, peer_addrs, timeout_s=timeout_s,
+                               fail_threshold=fail_threshold, seed=seed,
+                               backoff_base_s=backoff_base_s,
+                               backoff_cap_s=backoff_cap_s,
+                               faults=faults, metrics=self.metrics)
+        self.leases = LeaseManager(self_id, ttl_s=lease_ttl_s,
+                                   metrics=self.metrics)
+        self.antientropy = AntiEntropy(
+            self, interval_s=antientropy_interval_s)
+        self.probe_interval_s = probe_interval_s
+        # docs whose merges this host has admitted — the test surface
+        # for the exactly-one-merger property
+        self.merged_docs: Set[str] = set()
+        self._maintain_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- ownership -------------------------------------------------------
+
+    def ownership_ids(self) -> List[str]:
+        """Hosts rendezvous ownership is computed over: self plus every
+        peer that is healthy OR has been down for less than
+        `takeover_after_s`. The delay means a short partition does not
+        collapse each side's host set to itself — both sides keep
+        computing the same owner, so exactly one host admits merges.
+        Only an outage longer than a lease TTL (holder's lease provably
+        expired) reassigns ownership."""
+        now = time.monotonic()
+        ids = [self.self_id]
+        for p in self.table.peer_ids():
+            d = self.table.down_duration(p, now)
+            if d is None or d < self.takeover_after_s:
+                ids.append(p)
+        return sorted(ids)
+
+    def desired_owner(self, doc_id: str) -> str:
+        return owner_of(doc_id, self.ownership_ids())
+
+    def owns(self, doc_id: str) -> bool:
+        """The scheduler's merge-admission gate: True iff this host
+        holds (or may now acquire) the doc's ACTIVE lease."""
+        ok = self.leases.ensure_local(
+            doc_id, self.desired_owner(doc_id) == self.self_id)
+        self.metrics.bump("merge_gate", "admits" if ok else "denials")
+        if ok:
+            self.merged_docs.add(doc_id)
+        return ok
+
+    def route_mutation(self, doc_id: str) -> str:
+        """The host a write for `doc_id` should land on."""
+        holder = self.leases.holder_of(doc_id)
+        if holder is not None and (holder == self.self_id
+                                   or self.table.is_healthy(holder)):
+            return holder
+        return self.desired_owner(doc_id)
+
+    # ---- proxy -----------------------------------------------------------
+
+    def proxy(self, target: str, path: str,
+              body: bytes) -> Optional[Tuple[int, bytes]]:
+        """Forward a mutation to its owner. Returns (status, body) to
+        relay, or None when the owner is unreachable — the caller then
+        accepts locally (and anti-entropy reconciles)."""
+        try:
+            status, resp = self.table.call(
+                target, path, data=body,
+                headers={"X-DT-Proxied": "1"})
+        except urllib.error.HTTPError as e:
+            # owner answered with an application error: relay verbatim
+            status, resp = e.code, e.read()
+        except OSError:
+            self.metrics.bump("proxy", "fallback_local")
+            return None
+        self.metrics.bump("proxy", "proxied")
+        return status, resp
+
+    # ---- handoff (sender) ------------------------------------------------
+
+    def handoff(self, doc_id: str, new_owner: str) -> bool:
+        """Move doc ownership to `new_owner` without ever having two
+        active mergers: grant → drain → final patch → activate. Any
+        failure aborts back to ACTIVE (the remote GRANTED lease simply
+        expires)."""
+        t0 = time.monotonic()
+        new_epoch = self.leases.begin_handoff(doc_id)
+        if new_epoch is None:
+            return False
+        self.metrics.bump("handoffs", "started")
+        try:
+            # grant: the receiver records a not-yet-active lease (its
+            # TTL covers the whole handoff, so a crashed sender leaves
+            # a lease that expires rather than a stuck doc)
+            resp = self.table.call_json(
+                new_owner, "/replicate/lease",
+                {"action": "grant", "doc": doc_id, "epoch": new_epoch,
+                 "ttl_s": self.leases.ttl_s * 4})
+            if not resp.get("ok"):
+                raise ValueError(f"grant refused: {resp!r}")
+            # drain: flush our pending merge work for the doc so the
+            # final patch includes every admitted op
+            self.leases.advance_handoff(doc_id, DRAINING)
+            sched = getattr(self.store, "scheduler", None)
+            if sched is not None:
+                sched.drain()
+            # final patch transfer (from the receiver's common version)
+            self.leases.advance_handoff(doc_id, TRANSFER)
+            remote_summary = self.table.call_json(
+                new_owner, f"/doc/{doc_id}/summary")
+            ol = self.store.get(doc_id)
+            with self.store.lock:
+                common, _rem = intersect_with_summary(ol.cg,
+                                                      remote_summary)
+                patch = None
+                if sorted(common) != sorted(ol.version):
+                    patch = encode_oplog(ol, ENCODE_PATCH,
+                                         from_version=common)
+            if patch is not None:
+                self.table.call(new_owner, f"/doc/{doc_id}/push",
+                                data=patch)
+            # activate: receiver flips GRANTED -> ACTIVE; we release
+            resp = self.table.call_json(
+                new_owner, "/replicate/lease",
+                {"action": "activate", "doc": doc_id,
+                 "epoch": new_epoch})
+            if not resp.get("ok"):
+                raise ValueError(f"activate refused: {resp!r}")
+            self.leases.finish_handoff(doc_id, new_owner, new_epoch)
+            self.metrics.bump("handoffs", "completed")
+            self.metrics.observe_handoff_latency(time.monotonic() - t0)
+            return True
+        except (OSError, ValueError, KeyError,
+                urllib.error.HTTPError):
+            self.leases.abort_handoff(doc_id)
+            self.metrics.bump("handoffs", "failed")
+            return False
+
+    # ---- lease wire handler (receiver) -----------------------------------
+
+    def handle_lease_message(self, req: dict) -> dict:
+        action = req.get("action")
+        doc_id = req.get("doc")
+        if not isinstance(doc_id, str) or not doc_id:
+            return {"ok": False, "error": "bad doc"}
+        epoch = int(req.get("epoch", 0))
+        if action == "grant":
+            ok = self.leases.accept_grant(
+                doc_id, epoch, float(req.get("ttl_s", 0.0)))
+            return {"ok": ok}
+        if action == "activate":
+            ok = self.leases.activate_grant(doc_id, epoch)
+            return {"ok": ok}
+        if action == "status":
+            lease = self.leases.get(doc_id)
+            return {"ok": True,
+                    "lease": lease.as_json() if lease else None,
+                    "desired": self.desired_owner(doc_id)}
+        return {"ok": False, "error": f"bad action {action!r}"}
+
+    # ---- periodic control ------------------------------------------------
+
+    def maintain(self) -> dict:
+        """Renew held leases; hand off docs whose rendezvous owner
+        moved to a healthy peer. Serialized (probe loop + manual test
+        calls must not race two handoffs for one doc)."""
+        out = {"renewed": 0, "handoffs": 0}
+        with self._maintain_lock:
+            for doc_id in self.leases.held_ids():
+                desired = self.desired_owner(doc_id)
+                if desired == self.self_id:
+                    self.leases.ensure_local(doc_id, True)
+                    out["renewed"] += 1
+                elif self.table.is_healthy(desired):
+                    if self.handoff(doc_id, desired):
+                        out["handoffs"] += 1
+        return out
+
+    # ---- docs listing (for anti-entropy peers) ---------------------------
+
+    def docs_json(self) -> dict:
+        now = time.monotonic()
+        docs = {}
+        with self.leases.lock:
+            for doc_id in self.store.doc_ids():
+                lease = self.leases.leases.get(doc_id)
+                docs[doc_id] = {
+                    "lease": lease.as_json(now) if lease is not None
+                    and not lease.expired(now) else None}
+        return {"docs": docs, "self": self.self_id}
+
+    # ---- metrics ---------------------------------------------------------
+
+    def metrics_json(self) -> dict:
+        return self.metrics.snapshot(
+            leases_held=self.leases.held_count(),
+            per_peer=self.table.states(),
+            faults=self.faults.snapshot()
+            if self.faults is not None else None)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Probe + maintain loop and the anti-entropy loop."""
+        self.antientropy.start()
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.probe_interval_s):
+                try:
+                    self.table.probe_once()
+                    self.maintain()
+                except Exception:   # pragma: no cover - keep running
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.antientropy.stop()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self._stop = threading.Event()
+        self.table.stop_probe_loop()
+
+
+def attach_replication(httpd, self_id: str, peer_addrs: List[str],
+                       **opts) -> ReplicaNode:
+    """Wire a ReplicaNode onto a running server (tools/server.serve):
+    the store gains `.replica`, and the merge scheduler (when present)
+    gets the ownership admit gate. Split from serve() because tests
+    bind port 0 first and only then know their own `host:port`
+    identity."""
+    store = httpd.store
+    node = ReplicaNode(store, self_id, peer_addrs, **opts)
+    store.replica = node
+    if getattr(store, "scheduler", None) is not None:
+        store.scheduler.admit = node.owns
+    return node

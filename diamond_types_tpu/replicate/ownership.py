@@ -1,0 +1,278 @@
+"""Doc-ownership leases on rendezvous placement extended to hosts.
+
+Placement reuses the exact scheme serve/router.py proved out for chips
+— blake2b rendezvous (highest-random-weight) over the candidate set —
+but the candidates are *host ids* (`host:port`) and the set is the
+*currently healthy* mesh (PeerTable.healthy_ids). Every host computes
+the same owner for a doc given the same healthy set; transient health
+disagreements are resolved by the lease epoch, and convergence never
+depends on ownership anyway (anti-entropy replicates to non-owners).
+
+A lease is a host-local assertion "I run doc X's device merges until
+`expires_at`". Exactly-one-merger comes from the combination:
+
+  * a host only admits scheduler work for docs whose ACTIVE lease it
+    holds (`LeaseManager.ensure_local` — consulted by the scheduler's
+    admit gate);
+  * a host only acquires when rendezvous names it owner AND any known
+    remote lease has expired (dead-owner takeover bumps the epoch);
+  * moving ownership while both hosts are alive goes through the
+    explicit handoff state machine (driven by node.ReplicaNode):
+
+        ACTIVE --grant sent--> GRANTING --scheduler drained-->
+        DRAINING --final patch pushed--> TRANSFER --activate acked-->
+        RELEASED (local) / ACTIVE (remote, epoch+1)
+
+    A failure at any step rolls the local lease back to ACTIVE (same
+    epoch); the remote side's granted-but-never-activated lease simply
+    expires. The doc keeps exactly one active merger throughout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .metrics import ReplicationMetrics
+
+# lease states
+ACTIVE = "active"        # we merge this doc
+GRANTING = "granting"    # handoff: grant offered to the new owner
+DRAINING = "draining"    # handoff: draining our pending merges
+TRANSFER = "transfer"    # handoff: pushing the final patch
+GRANTED = "granted"      # remote offered US the lease; not active yet
+RELEASED = "released"    # terminal; kept briefly for observability
+
+_HANDOFF_STATES = (GRANTING, DRAINING, TRANSFER)
+
+
+def _score(doc_id: str, host_id: str, salt: bytes) -> int:
+    h = hashlib.blake2b(digest_size=8, salt=salt[:16])
+    h.update(doc_id.encode("utf8"))
+    h.update(host_id.encode("utf8"))
+    return int.from_bytes(h.digest(), "little")
+
+
+def owner_of(doc_id: str, host_ids: Sequence[str],
+             salt: str = "dt-replicate") -> str:
+    """Rendezvous owner of `doc_id` among `host_ids` — pure function of
+    its arguments, so every process that sees the same healthy set
+    picks the same owner (ties broken by the lexically smaller id)."""
+    if not host_ids:
+        raise ValueError("empty host set")
+    salt_b = salt.encode("utf8")
+    best, best_score = None, -1
+    for hid in sorted(host_ids):
+        sc = _score(doc_id, hid, salt_b)
+        if sc > best_score:
+            best, best_score = hid, sc
+    return best
+
+
+class Lease:
+    __slots__ = ("doc_id", "holder", "epoch", "state", "expires_at",
+                 "granted_at")
+
+    def __init__(self, doc_id: str, holder: str, epoch: int,
+                 state: str, expires_at: float) -> None:
+        self.doc_id = doc_id
+        self.holder = holder
+        self.epoch = epoch
+        self.state = state
+        self.expires_at = expires_at     # monotonic, local clock
+        self.granted_at = time.monotonic()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (time.monotonic() if now is None else now) \
+            >= self.expires_at
+
+    def as_json(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        # TTL remaining, not absolute time: peer clocks are not synced
+        return {"holder": self.holder, "epoch": self.epoch,
+                "state": self.state,
+                "ttl_s": round(max(self.expires_at - now, 0.0), 3)}
+
+
+class LeaseManager:
+    """Host-local lease records for every doc this host has an opinion
+    about (its own leases + leases observed from peers via grant
+    messages and /replicate/docs piggyback)."""
+
+    def __init__(self, self_id: str, ttl_s: float = 2.0,
+                 metrics: Optional[ReplicationMetrics] = None) -> None:
+        self.self_id = self_id
+        self.ttl_s = ttl_s
+        self.metrics = metrics
+        self.leases: Dict[str, Lease] = {}
+        self.lock = threading.RLock()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.bump("leases", key, n)
+
+    # ---- views -----------------------------------------------------------
+
+    def get(self, doc_id: str) -> Optional[Lease]:
+        with self.lock:
+            return self.leases.get(doc_id)
+
+    def held_ids(self) -> List[str]:
+        with self.lock:
+            return sorted(d for d, l in self.leases.items()
+                          if l.holder == self.self_id
+                          and l.state in (ACTIVE,) + _HANDOFF_STATES)
+
+    def held_count(self) -> int:
+        return len(self.held_ids())
+
+    def holder_of(self, doc_id: str,
+                  now: Optional[float] = None) -> Optional[str]:
+        """Current unexpired lease holder, if any is known."""
+        with self.lock:
+            lease = self.leases.get(doc_id)
+            if lease is None or lease.state == RELEASED \
+                    or lease.expired(now):
+                return None
+            return lease.holder
+
+    # ---- acquisition -----------------------------------------------------
+
+    def ensure_local(self, doc_id: str, is_desired_owner: bool,
+                     now: Optional[float] = None) -> bool:
+        """The merge-admission question: may THIS host run doc X's
+        merges right now? Acquires/renews the local lease when
+        rendezvous names us owner and no live conflicting lease exists.
+        Returns False while another host's unexpired lease stands
+        (handoff pending or split health view) and during our own
+        outbound handoff (the new owner merges next, not us)."""
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            lease = self.leases.get(doc_id)
+            if lease is not None and lease.holder == self.self_id:
+                if lease.state == ACTIVE:
+                    if not is_desired_owner:
+                        # placement moved away; keep serving until the
+                        # handoff runs (node drives it) — merges must
+                        # not stall in the gap
+                        pass
+                    lease.expires_at = now + self.ttl_s
+                    self._bump("renewals")
+                    return True
+                if lease.state in _HANDOFF_STATES:
+                    return False     # outbound handoff in progress
+                if lease.state == GRANTED:
+                    # we were offered the lease but activation hasn't
+                    # arrived; the grantor is still draining/merging
+                    return False
+            if not is_desired_owner:
+                return False
+            if lease is not None and lease.holder != self.self_id \
+                    and not lease.expired(now):
+                return False         # live remote lease wins
+            # free (no lease, expired, or released): acquire
+            epoch = 1 if lease is None else lease.epoch + 1
+            takeover = (lease is not None
+                        and lease.holder != self.self_id
+                        and lease.state != RELEASED)
+            self.leases[doc_id] = Lease(doc_id, self.self_id, epoch,
+                                        ACTIVE, now + self.ttl_s)
+            self._bump("takeovers" if takeover else "acquires")
+            return True
+
+    # ---- remote observations ---------------------------------------------
+
+    def observe_remote(self, doc_id: str, holder: str, epoch: int,
+                       state: str, ttl_s: float) -> None:
+        """Fold a peer's lease claim (grant message or /replicate/docs
+        piggyback). Higher epoch wins; equal epochs keep the holder with
+        the lexically smaller id (same tie-break as rendezvous)."""
+        now = time.monotonic()
+        with self.lock:
+            cur = self.leases.get(doc_id)
+            if cur is not None and (cur.epoch > epoch or (
+                    cur.epoch == epoch and cur.holder <= holder)):
+                return
+            self.leases[doc_id] = Lease(
+                doc_id, holder, epoch, state, now + max(ttl_s, 0.0))
+
+    def accept_grant(self, doc_id: str, epoch: int,
+                     ttl_s: float) -> bool:
+        """Remote handoff step 1 (receiver): record the offered lease
+        as GRANTED-not-active. Idempotent; refuses stale epochs."""
+        now = time.monotonic()
+        with self.lock:
+            cur = self.leases.get(doc_id)
+            if cur is not None and cur.epoch >= epoch \
+                    and not (cur.holder == self.self_id
+                             and cur.epoch == epoch):
+                return False
+            self.leases[doc_id] = Lease(doc_id, self.self_id, epoch,
+                                        GRANTED, now + max(ttl_s, 0.0))
+            return True
+
+    def activate_grant(self, doc_id: str, epoch: int) -> bool:
+        """Remote handoff final step (receiver): flip GRANTED→ACTIVE.
+        Idempotent (duplicate activate messages are harmless)."""
+        now = time.monotonic()
+        with self.lock:
+            cur = self.leases.get(doc_id)
+            if cur is None or cur.holder != self.self_id \
+                    or cur.epoch != epoch:
+                return False
+            if cur.state == ACTIVE:
+                return True
+            if cur.state != GRANTED:
+                return False
+            cur.state = ACTIVE
+            cur.expires_at = now + self.ttl_s
+            self._bump("acquires")
+            return True
+
+    # ---- handoff (sender side; steps driven by node.ReplicaNode) ---------
+
+    def begin_handoff(self, doc_id: str) -> Optional[int]:
+        """ACTIVE → GRANTING. Returns the epoch the NEW owner's lease
+        will carry (ours + 1), or None if we don't hold the doc."""
+        with self.lock:
+            lease = self.leases.get(doc_id)
+            if lease is None or lease.holder != self.self_id \
+                    or lease.state != ACTIVE:
+                return None
+            lease.state = GRANTING
+            return lease.epoch + 1
+
+    def advance_handoff(self, doc_id: str, state: str) -> None:
+        assert state in (DRAINING, TRANSFER)
+        with self.lock:
+            lease = self.leases[doc_id]
+            lease.state = state
+
+    def finish_handoff(self, doc_id: str, new_holder: str,
+                       new_epoch: int) -> None:
+        """Local release + record the new owner's active lease."""
+        now = time.monotonic()
+        with self.lock:
+            self.leases[doc_id] = Lease(doc_id, new_holder, new_epoch,
+                                        ACTIVE, now + self.ttl_s)
+            self._bump("releases")
+
+    def abort_handoff(self, doc_id: str) -> None:
+        """Roll a failed handoff back to ACTIVE (same epoch): the
+        receiver's GRANTED lease is never activated and just expires."""
+        with self.lock:
+            lease = self.leases.get(doc_id)
+            if lease is not None and lease.holder == self.self_id \
+                    and lease.state in _HANDOFF_STATES:
+                lease.state = ACTIVE
+                lease.expires_at = time.monotonic() + self.ttl_s
+
+    # ---- export ----------------------------------------------------------
+
+    def as_json(self) -> dict:
+        now = time.monotonic()
+        with self.lock:
+            return {d: lease.as_json(now)
+                    for d, lease in sorted(self.leases.items())}

@@ -1,0 +1,190 @@
+"""In-process N-server replication soak (CLI: `replicate-soak`).
+
+Boots N sync servers on ephemeral localhost ports, wires them into one
+mesh sharing a single seeded FaultInjector, then drives rounds of
+client edits at random servers while dropping, delaying and
+partitioning the inter-server links. After the fault window every
+partition heals and reconciliation rounds run until every server holds
+byte-identical text for every doc (or the round budget runs out).
+
+Stepping is inline and single-threaded on purpose — probes, lease
+maintenance and anti-entropy advance once per round in a fixed order —
+so a given seed replays the exact fault schedule (see faults.py's
+determinism contract). The HTTP servers themselves still run real
+threads; only the *replication control plane* is stepped.
+
+Invariants checked:
+  * convergence — all servers byte-identical on every doc;
+  * owner-only merges — at any point in time one host admits a doc's
+    merges; across the run a doc may legitimately appear in several
+    hosts' merged sets (lease takeover after a partition), reported as
+    `multi_merger_docs` and required to be 0 when no partition was
+    configured.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.request
+from typing import Dict, List
+
+from .faults import FaultInjector
+from .node import attach_replication
+
+_WORDS = ("sync", "merge", "lease", "patch", "shard", "probe",
+          "quorum", "epoch", "drain", "heal")
+
+
+def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
+                       edits_per_round: int = 4, seed: int = 7,
+                       drop_rate: float = 0.15, delay_rate: float = 0.0,
+                       max_delay_s: float = 0.0, dup_rate: float = 0.05,
+                       partition_rounds: int = 6,
+                       reconcile_rounds: int = 12,
+                       lease_ttl_s: float = 1.0,
+                       serve_shards: int = 0,
+                       progress: bool = False) -> dict:
+    from ..tools.server import SyncClient, serve
+
+    rng = random.Random(seed)
+    faults = FaultInjector(seed=seed, drop_rate=drop_rate,
+                           dup_rate=dup_rate, delay_rate=delay_rate,
+                           max_delay_s=max_delay_s)
+    httpds, nodes, addrs = [], [], []
+    for _ in range(servers):
+        httpd = serve(port=0, serve_shards=serve_shards)
+        httpds.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    for i, httpd in enumerate(httpds):
+        node = attach_replication(
+            httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+            seed=seed, lease_ttl_s=lease_ttl_s, faults=faults,
+            timeout_s=2.0, backoff_base_s=0.02, backoff_cap_s=0.1)
+        nodes.append(node)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+    doc_ids = [f"soak-{i}" for i in range(docs)]
+    clients: Dict[tuple, SyncClient] = {}
+
+    def client(server_i: int, doc_id: str) -> SyncClient:
+        key = (server_i, doc_id)
+        if key not in clients:
+            clients[key] = SyncClient(
+                f"http://{addrs[server_i]}", doc_id,
+                f"agent-{server_i}-{doc_id}", retries=2)
+        return clients[key]
+
+    def step_control_plane() -> None:
+        for node in nodes:
+            node.table.probe_once()
+            node.maintain()
+        for node in nodes:
+            node.antientropy.run_round()
+
+    part_pair = (addrs[0], addrs[1]) if servers >= 2 \
+        and partition_rounds > 0 else None
+    t0 = time.monotonic()
+    edits = 0
+    for r in range(rounds):
+        if part_pair and r == 1:
+            faults.partition(*part_pair)
+        if part_pair and r == 1 + partition_rounds:
+            faults.heal(*part_pair)
+        for _ in range(edits_per_round):
+            si = rng.randrange(servers)
+            doc = rng.choice(doc_ids)
+            c = client(si, doc)
+            try:
+                c.pull()
+            except OSError:
+                pass    # client keeps editing its local replica
+            pos = rng.randrange(len(c.text()) + 1)
+            c.insert(pos, rng.choice(_WORDS) + " ")
+            try:
+                c.sync()
+                edits += 1
+            except OSError:
+                pass    # retries exhausted mid-fault; next round
+        step_control_plane()
+        if progress:
+            print(f"round {r + 1}/{rounds}: {edits} edits applied")
+
+    # fault window over: heal everything and reconcile to convergence
+    faults.heal()
+    converged_after = None
+    for r in range(reconcile_rounds):
+        time.sleep(0.05)   # let breaker backoff windows lapse
+        step_control_plane()
+        if _converged(addrs, doc_ids):
+            converged_after = r + 1
+            break
+
+    texts = _final_texts(addrs, doc_ids)
+    converged = all(len(set(v.values())) == 1 for v in texts.values())
+    mergers = {d: sorted(n.self_id for n in nodes
+                         if d in n.merged_docs) for d in doc_ids}
+    multi = sorted(d for d, who in mergers.items() if len(who) > 1)
+    report = {
+        "config": {"servers": servers, "docs": docs, "rounds": rounds,
+                   "edits_per_round": edits_per_round, "seed": seed,
+                   "drop_rate": drop_rate, "dup_rate": dup_rate,
+                   "partition_rounds": partition_rounds,
+                   "lease_ttl_s": lease_ttl_s,
+                   "serve_shards": serve_shards},
+        "edits_applied": edits,
+        "converged": converged,
+        "converged_after_reconcile_rounds": converged_after,
+        "multi_merger_docs": multi,
+        "mergers": mergers,
+        "doc_lengths": {d: {a: len(t) for a, t in v.items()}
+                        for d, v in texts.items()},
+        "faults": faults.snapshot(),
+        "wall_s": round(time.monotonic() - t0, 3),
+        "metrics": {addrs[i]: nodes[i].metrics_json()
+                    for i in range(servers)},
+    }
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    return report
+
+
+def _get_text(addr: str, doc_id: str) -> str:
+    with urllib.request.urlopen(f"http://{addr}/doc/{doc_id}",
+                                timeout=5) as r:
+        return r.read().decode("utf8")
+
+
+def _final_texts(addrs: List[str],
+                 doc_ids: List[str]) -> Dict[str, Dict[str, str]]:
+    return {d: {a: _get_text(a, d) for a in addrs} for d in doc_ids}
+
+
+def _converged(addrs: List[str], doc_ids: List[str]) -> bool:
+    for d in doc_ids:
+        if len({_get_text(a, d) for a in addrs}) > 1:
+            return False
+    return True
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised via cli.py
+    import argparse
+    p = argparse.ArgumentParser(prog="replicate-soak")
+    p.add_argument("--servers", type=int, default=3)
+    p.add_argument("--docs", type=int, default=4)
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--drop-rate", type=float, default=0.15)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    report = run_replicate_soak(servers=args.servers, docs=args.docs,
+                                rounds=args.rounds, seed=args.seed,
+                                drop_rate=args.drop_rate)
+    print(json.dumps(report if args.json else {
+        k: report[k] for k in ("converged", "edits_applied",
+                               "multi_merger_docs", "wall_s")}))
+    return 0 if report["converged"] else 1

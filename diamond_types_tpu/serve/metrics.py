@@ -7,8 +7,10 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"shards": N, "flush_docs": B,
-   "totals": {"submits", "coalesced", "rejects", "flushes",
+  {"version": 2,                   # counter-set schema; bump on change
+   "uptime_s": s,                  # monotonic since construction
+   "shards": N, "flush_docs": B,
+   "totals": {"submits", "coalesced", "rejects", "denied", "flushes",
               "flushed_docs", "flushed_ops", "builds", "evictions",
               "resyncs", "syncs", "host_fallbacks"},
    "batch_occupancy": mean(flush size) / flush_docs,   # 0..1
@@ -24,20 +26,31 @@ Schema (snapshot()):
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Dict, List
 
 
-_SHARD_KEYS = ("submits", "coalesced", "rejects", "flushes",
+_SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "flushes",
                "flushed_docs", "flushed_ops", "builds", "evictions",
                "resyncs", "syncs", "host_fallbacks")
 
 
 class ServeMetrics:
+    # bump whenever the counter set changes so bench/soak tooling can
+    # detect schema drift across PRs (satellite of the replication PR:
+    # v2 = uptime_s + version + the `denied` ownership-gate counter)
+    SCHEMA_VERSION = 2
+
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
         self.n_shards = n_shards
         self.flush_docs = flush_docs
         self.max_pending = max_pending
+        self.started_at = time.monotonic()
+        # flush recording now happens OUTSIDE the scheduler's global
+        # lock (per-shard flush locks); counters get their own lock
+        self._lock = threading.Lock()
         self.shard: List[Dict[str, int]] = [
             {k: 0 for k in _SHARD_KEYS} for _ in range(n_shards)]
         self.flush_reasons: Dict[str, int] = {}
@@ -50,37 +63,51 @@ class ServeMetrics:
     # ---- recording -------------------------------------------------------
 
     def bump(self, shard: int, key: str, n: int = 1) -> None:
-        self.shard[shard][key] += n
+        with self._lock:
+            self.shard[shard][key] += n
 
     def record_flush(self, shard: int, n_docs: int, n_ops: int,
                      reason: str) -> None:
-        c = self.shard[shard]
-        c["flushes"] += 1
-        c["flushed_docs"] += n_docs
-        c["flushed_ops"] += n_ops
-        self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
-        self.flush_size_hist[n_docs] = \
-            self.flush_size_hist.get(n_docs, 0) + 1
+        with self._lock:
+            c = self.shard[shard]
+            c["flushes"] += 1
+            c["flushed_docs"] += n_docs
+            c["flushed_ops"] += n_ops
+            self.flush_reasons[reason] = \
+                self.flush_reasons.get(reason, 0) + 1
+            self.flush_size_hist[n_docs] = \
+                self.flush_size_hist.get(n_docs, 0) + 1
 
     def observe_queue(self, shard: int, depth: int) -> None:
-        self.queue_depth[shard] = depth
-        if depth > self.max_depth_seen:
-            self.max_depth_seen = depth
-        if depth > self.max_pending:
-            # must stay 0: the bounded-queue contract (admission raises
-            # Backpressure before this point); nonzero = a real bug
-            self.queue_bound_violations += 1
+        with self._lock:
+            self.queue_depth[shard] = depth
+            if depth > self.max_depth_seen:
+                self.max_depth_seen = depth
+            if depth > self.max_pending:
+                # must stay 0: the bounded-queue contract (admission
+                # raises Backpressure before this point); nonzero = a
+                # real bug
+                self.queue_bound_violations += 1
 
     def observe_footprint(self, shard: int, slots: int) -> None:
-        self.footprint_slots[shard] = int(slots)
+        with self._lock:
+            self.footprint_slots[shard] = int(slots)
 
     # ---- export ----------------------------------------------------------
 
     def snapshot(self) -> dict:
-        totals = {k: sum(s[k] for s in self.shard) for k in _SHARD_KEYS}
-        flushes = max(totals["flushes"], 1)
-        occupancy = (totals["flushed_docs"] / flushes) / self.flush_docs
+        with self._lock:
+            totals = {k: sum(s[k] for s in self.shard)
+                      for k in _SHARD_KEYS}
+            flushes = max(totals["flushes"], 1)
+            occupancy = (totals["flushed_docs"] / flushes) \
+                / self.flush_docs
+            return self._snapshot_locked(totals, occupancy)
+
+    def _snapshot_locked(self, totals, occupancy) -> dict:
         return {
+            "version": self.SCHEMA_VERSION,
+            "uptime_s": round(time.monotonic() - self.started_at, 3),
             "shards": self.n_shards,
             "flush_docs": self.flush_docs,
             "max_pending": self.max_pending,

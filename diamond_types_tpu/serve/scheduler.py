@@ -8,12 +8,20 @@ the bucket back-to-back on that shard's chip, so they share the padded
 micro-tape shape (and therefore the jit cache entry) instead of each
 paying its own compile.
 
-Threading: a single lock guards the queue + banks. The intended callers
-are (a) HTTP handler threads submitting, (b) ONE pump thread flushing
-(`start_pump`), and (c) bench drivers doing both inline. Device work
-runs while holding the lock — by design, since one chip per shard can
-only run one program at a time anyway; submits during a flush simply
-queue for the next pump.
+Threading: the global `lock` guards router + queue mutation only; each
+shard's BANK has its own lock, so flushes (the device work) run with
+the global lock RELEASED and different shards flush concurrently —
+submits and reads for other shards never stall behind one shard's
+device call. Lock order is always global → shard → sync_lock, never
+reversed. Intended callers: (a) HTTP handler threads submitting and
+reading, (b) pump threads flushing (`start_pump`), and (c) bench
+drivers doing both inline.
+
+Ownership gate: when `admit` is set (cross-host replication — a
+`replicate.ReplicaNode.owns` bound method), `submit` consults it first
+and refuses merge work for docs whose lease this host does not hold;
+the edit stays durable in the oplog, the device work runs on the
+lease-holding host instead.
 """
 
 from __future__ import annotations
@@ -40,7 +48,8 @@ class MergeScheduler:
                  flush_deadline_s: float = 0.05,
                  place_on_devices: bool = False,
                  session_opts: Optional[dict] = None,
-                 sync_lock=None) -> None:
+                 sync_lock=None,
+                 admit: Optional[Callable[[str], bool]] = None) -> None:
         """`resolve(doc_id) -> OpLog` is the document authority —
         DocStore.get fits directly. `sync_lock` (e.g. DocStore.lock) is
         held around each doc's sync so bank reads never race handler
@@ -64,7 +73,11 @@ class MergeScheduler:
                         device=devices[i], metrics=self.metrics,
                         session_opts=session_opts)
             for i in range(n_shards)]
+        # `admit(doc_id) -> bool` — the cross-host ownership gate
+        # (replicate.ReplicaNode.owns); None = single-host, admit all
+        self.admit = admit
         self.lock = threading.Lock()
+        self._shard_locks = [threading.Lock() for _ in range(n_shards)]
         self._pump_stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
 
@@ -73,9 +86,18 @@ class MergeScheduler:
     def submit(self, doc_id: str, n_ops: int = 1,
                now: Optional[float] = None) -> dict:
         """Queue pending merge work. Returns {"accepted": True, "shard",
-        "bucket"} or {"accepted": False, "retry_after"} on backpressure
-        (never raises — rejects are normal operation under load)."""
+        "bucket"}, {"accepted": False, "retry_after"} on backpressure,
+        or {"accepted": False, "reason": "not_owner"} when the
+        ownership gate denies (never raises — rejects and denials are
+        normal operation under load / during handoff)."""
         now = time.monotonic() if now is None else now
+        if self.admit is not None and not self.admit(doc_id):
+            # shard_of (not assign): a denied doc must not register a
+            # live assignment this host will never flush
+            shard = self.router.shard_of(doc_id)
+            self.metrics.bump(shard, "denied")
+            return {"accepted": False, "shard": shard,
+                    "reason": "not_owner"}
         with self.lock:
             shard = self.router.assign(doc_id)
             self.metrics.bump(shard, "submits")
@@ -95,26 +117,42 @@ class MergeScheduler:
 
     def pump(self, now: Optional[float] = None,
              force: bool = False) -> int:
-        """Flush every due bucket. Returns the number of docs synced."""
+        """Flush every due bucket. Returns the number of docs synced.
+
+        Queue mutation (due/take) happens under the global lock; the
+        sync work itself runs under each shard's OWN lock with the
+        global lock released, so shards flush concurrently and submits
+        never wait on device calls (ROADMAP item (a) groundwork)."""
         now = time.monotonic() if now is None else now
-        synced = 0
+        taken = []      # (shard, reason, items)
         with self.lock:
             for shard, bucket, reason in self.queue.due(now, force=force):
                 items = self.queue.take(shard, bucket)
-                if not items:
-                    continue
-                bank = self.banks[shard]
-                for item in items:
-                    ol = self.resolve(item.doc_id)
-                    with self._sync_lock:
-                        bank.sync_doc(item.doc_id, ol)
-                    synced += 1
-                self.metrics.record_flush(
-                    shard, len(items), sum(i.n_ops for i in items),
-                    reason)
-                self.metrics.observe_queue(shard,
-                                           self.queue.depth(shard))
+                if items:
+                    taken.append((shard, reason, items))
+        synced = 0
+        for shard, reason, items in taken:
+            self._flush_items(shard, reason, items)
+            synced += len(items)
+        if taken:
+            with self.lock:
+                for shard, _reason, _items in taken:
+                    self.metrics.observe_queue(
+                        shard, self.queue.depth(shard))
         return synced
+
+    def _flush_items(self, shard: int, reason: str, items) -> None:
+        """Sync one taken batch into its shard's bank, under that
+        shard's lock only (items are already off the queue, so a
+        concurrent submit for the same doc simply queues fresh work)."""
+        bank = self.banks[shard]
+        with self._shard_locks[shard]:
+            for item in items:
+                ol = self.resolve(item.doc_id)
+                with self._sync_lock:
+                    bank.sync_doc(item.doc_id, ol)
+        self.metrics.record_flush(
+            shard, len(items), sum(i.n_ops for i in items), reason)
 
     def drain(self) -> int:
         """Flush everything regardless of triggers (shutdown, rebalance,
@@ -136,22 +174,19 @@ class MergeScheduler:
         with self.lock:
             shard = self.router.assign(doc_id)
             bucket = self.queue.pending_bucket(shard, doc_id)
+            items = []
             if bucket is not None:
                 # flush the doc's whole bucket (its neighbors share the
                 # shape anyway), counted as a read-triggered flush
                 items = self.queue.take(shard, bucket,
                                         limit=self.queue.max_pending)
-                bank = self.banks[shard]
-                for item in items:
-                    ol = self.resolve(item.doc_id)
-                    with self._sync_lock:
-                        bank.sync_doc(item.doc_id, ol)
-                self.metrics.record_flush(
-                    shard, len(items), sum(i.n_ops for i in items),
-                    "read")
+        if items:
+            self._flush_items(shard, "read", items)
+            with self.lock:
                 self.metrics.observe_queue(shard,
                                            self.queue.depth(shard))
-            ol = self.resolve(doc_id)
+        ol = self.resolve(doc_id)
+        with self._shard_locks[shard]:
             with self._sync_lock:
                 return self.banks[shard].text(doc_id, ol)
 
@@ -168,9 +203,10 @@ class MergeScheduler:
         self.drain()
         with self.lock:
             moved = self.router.rebalance(n_shards)
-            for doc_id, (old, _new) in moved.items():
+        for doc_id, (old, _new) in moved.items():
+            with self._shard_locks[old]:
                 self.banks[old].evict(doc_id)
-            return moved
+        return moved
 
     def metrics_json(self) -> dict:
         snap = self.metrics.snapshot()

@@ -270,6 +270,38 @@ def cmd_serve_bench(args) -> int:
     return 0 if report["parity_ok"] else 1
 
 
+def cmd_replicate_soak(args) -> int:
+    """N in-process sync servers in one fault-injected replication
+    mesh: drive edits through drops/partitions, heal, reconcile, and
+    gate on byte-identical convergence (see replicate/soak.py)."""
+    from ..replicate.soak import run_replicate_soak
+    report = run_replicate_soak(
+        servers=args.servers, docs=args.docs, rounds=args.rounds,
+        edits_per_round=args.edits_per_round, seed=args.seed,
+        drop_rate=args.drop_rate, dup_rate=args.dup_rate,
+        partition_rounds=args.partition_rounds,
+        reconcile_rounds=args.reconcile_rounds,
+        lease_ttl_s=args.lease_ttl, serve_shards=args.serve_shards,
+        progress=args.progress)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"replicate-soak: {report['config']['servers']} servers / "
+              f"{report['config']['docs']} docs, "
+              f"{report['edits_applied']} edits through "
+              f"{report['faults']['drops']} drops + "
+              f"{report['faults']['partition_blocks']} partition blocks "
+              f"in {report['wall_s']}s: "
+              f"{'CONVERGED' if report['converged'] else 'DIVERGED'}"
+              + (f" after {report['converged_after_reconcile_rounds']} "
+                 f"reconcile rounds"
+                 if report["converged_after_reconcile_rounds"] else ""))
+    return 0 if report["converged"] else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dt-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -347,6 +379,28 @@ def main(argv=None) -> int:
     c.add_argument("--real-device", action="store_true",
                    help="skip the CPU-simulation env pinning")
     c.set_defaults(fn=cmd_serve_bench)
+
+    c = sub.add_parser(
+        "replicate-soak",
+        help="fault-injected N-server replication convergence soak")
+    c.add_argument("--servers", type=int, default=3)
+    c.add_argument("--docs", type=int, default=4)
+    c.add_argument("--rounds", type=int, default=20)
+    c.add_argument("--edits-per-round", type=int, default=4)
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--drop-rate", type=float, default=0.15)
+    c.add_argument("--dup-rate", type=float, default=0.05)
+    c.add_argument("--partition-rounds", type=int, default=6,
+                   help="rounds the server0<->server1 link stays cut")
+    c.add_argument("--reconcile-rounds", type=int, default=12)
+    c.add_argument("--lease-ttl", type=float, default=1.0)
+    c.add_argument("--serve-shards", type=int, default=0,
+                   help="attach the host-engine merge scheduler with "
+                   "N shards on every server (ownership-gated)")
+    c.add_argument("--progress", action="store_true")
+    c.add_argument("--json", action="store_true")
+    c.add_argument("--metrics-out")
+    c.set_defaults(fn=cmd_replicate_soak)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -48,7 +48,26 @@ web_assets.py for the pages):
                             DT_SERVER_DEVICE=1 the whole strip is ONE
                             batched device call (texts_at_versions)
 
+Replication tier (--peers host:port,... — diamond_types_tpu/replicate/;
+N server instances jointly own the document space):
+
+  GET  /replicate/ping      -> {"ok", "id", "uptime_s"} health probe
+  GET  /replicate/docs      -> {"docs": {id: {"lease": {holder, epoch,
+                            state, ttl_s} | null}}, "self"} — doc list
+                            + piggybacked lease claims (anti-entropy)
+  POST /replicate/lease     body {"action": "grant"|"activate"|"status",
+                            "doc", "epoch", "ttl_s"?} -> {"ok": bool}
+                            — the handoff wire protocol (idempotent)
+
+  Ownership: rendezvous placement of docs over the healthy host set
+  (replicate/ownership.py) + leases; mutations (/push, /edit, /ops)
+  for a doc owned elsewhere are proxied to the lease holder (header
+  X-DT-Proxied stops a second hop; an unreachable owner degrades to a
+  local accept that anti-entropy reconciles). Lease state machine and
+  failure modes: serve/README.md.
+
 Run: python -m diamond_types_tpu.tools.server --port 8008 --data-dir docs/
+     [--serve-shards N] [--peers host:port,host:port,...]
 """
 
 from __future__ import annotations
@@ -94,6 +113,12 @@ class DocStore:
         # shard; its pump thread keeps the session banks warm so reads
         # can come off pre-merged state instead of a cold checkout.
         self.scheduler = None
+        # Optional replication node (replicate/): peer mesh membership,
+        # doc-ownership leases, anti-entropy. Attached via
+        # replicate.attach_replication; when present, mutations for
+        # docs this host doesn't own are proxied to the lease holder
+        # and the scheduler's admit gate keeps merges owner-only.
+        self.replica = None
         self.lock = threading.Lock()
         self.io_lock = threading.Lock()   # serializes flush passes
         # Long-poll wakeups (one condition per doc; notified on new ops).
@@ -159,6 +184,18 @@ class DocStore:
         if self.data_dir is None:
             return None
         return os.path.join(self.data_dir, doc_id + ".dt")
+
+    def doc_ids(self):
+        """Every doc this store knows: in-memory oplogs plus persisted
+        .dt files not yet loaded (anti-entropy peers list against this,
+        so a restarted server still offers its on-disk docs)."""
+        with self.lock:
+            ids = set(self.docs)
+        if self.data_dir and os.path.isdir(self.data_dir):
+            for name in os.listdir(self.data_dir):
+                if name.endswith(".dt") and _DOC_ID_RE.match(name[:-3]):
+                    ids.add(name[:-3])
+        return sorted(ids)
 
     def get(self, doc_id: str) -> OpLog:
         with self.lock:
@@ -517,11 +554,30 @@ class SyncHandler(BaseHTTPRequestHandler):
                               "text/html; charset=utf-8")
         if self.path == "/metrics":
             # serve/ scheduler counters (queue depths, flush sizes,
-            # occupancy, evictions...) — JSON for bench/soak scrapers
+            # occupancy, evictions...) + replicate/ counters (leases,
+            # handoffs, anti-entropy, per-peer backoff state) — JSON
+            # for bench/soak scrapers
             sched = self.store.scheduler
+            node = self.store.replica
             body = json.dumps(
-                {"serve": sched.metrics_json() if sched else None})
+                {"serve": sched.metrics_json() if sched else None,
+                 "replication": node.metrics_json() if node else None})
             return self._send(200, body.encode("utf8"))
+        if parts and parts[0] == "replicate":
+            node = self.store.replica
+            if node is None:
+                return self._send(404, b"{}")
+            if len(parts) == 2 and parts[1] == "ping":
+                return self._send(200, json.dumps(
+                    {"ok": True, "id": node.self_id,
+                     "uptime_s": round(
+                         time.monotonic() - node.started_at, 3)})
+                    .encode("utf8"))
+            if len(parts) == 2 and parts[1] == "docs":
+                # doc list + piggybacked lease claims (anti-entropy)
+                return self._send(200, json.dumps(node.docs_json())
+                                  .encode("utf8"))
+            return self._send(404, b"{}")
         if len(parts) == 2 and parts[0] in ("edit", "vis", "crdt"):
             if not _DOC_ID_RE.match(parts[1]):
                 return self._send(404, b"{}")
@@ -579,11 +635,38 @@ class SyncHandler(BaseHTTPRequestHandler):
                 pass  # client already gone
 
     def _do_post(self):
+        parts = self.path.strip("/").split("/")
+        if parts[:1] == ["replicate"]:
+            node = self.store.replica
+            if node is None or parts[1:] != ["lease"]:
+                return self._send(404, b"{}")
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            return self._send(200, json.dumps(
+                node.handle_lease_message(req)).encode("utf8"))
         doc_id, action = self._route()
         if doc_id is None:
             return self._send(404, b"{}")
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        node = self.store.replica
+        if node is not None and action in ("push", "edit", "ops"):
+            # Mutations belong on the doc's lease holder: proxy them
+            # there so device merges run on exactly one host. A request
+            # that already hopped once is never re-proxied (two hosts
+            # with a split health view would otherwise bounce it
+            # forever) and an unreachable owner degrades to a local
+            # accept — the edit is durable here, the merge gate keeps
+            # device work off this host, anti-entropy reconciles.
+            target = node.route_mutation(doc_id)
+            if target != node.self_id:
+                if self.headers.get("X-DT-Proxied") is not None:
+                    node.metrics.bump("proxy", "loops_refused")
+                else:
+                    relay = node.proxy(target, self.path, body)
+                    if relay is not None:
+                        status, resp = relay
+                        return self._send(status, resp)
         ol = self.store.get(doc_id)
         if action == "pull":
             summary = json.loads(body or b"{}")
@@ -790,6 +873,8 @@ class _Server(ThreadingHTTPServer):
 
     def server_close(self):  # final flush on clean shutdown
         if self.store is not None:
+            if self.store.replica is not None:
+                self.store.replica.stop()
             if self.store.scheduler is not None:
                 self.store.scheduler.stop_pump(drain=True)
             self.store.stop_flusher()
@@ -798,7 +883,15 @@ class _Server(ThreadingHTTPServer):
 
 
 def serve(port: int = 8008, data_dir: Optional[str] = None,
-          serve_shards: int = 0) -> ThreadingHTTPServer:
+          serve_shards: int = 0, peers: Optional[list] = None,
+          replicate_opts: Optional[dict] = None) -> ThreadingHTTPServer:
+    """`peers` is the static mesh (["host:port", ...], may include
+    this server's own address — it is dropped from the table). With
+    peers set, a replicate.ReplicaNode is attached and started: health
+    probes, lease maintenance and anti-entropy run in the background,
+    and mutations for docs owned elsewhere are proxied. Tests that
+    bind port 0 call replicate.attach_replication themselves once the
+    ephemeral port is known."""
     store = DocStore(data_dir)
     if serve_shards:
         # engine="host" on purpose: this process serves HTTP, and
@@ -815,16 +908,33 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
     handler = type("Handler", (SyncHandler,), {"store": store})
     httpd = _Server(("127.0.0.1", port), handler)
     httpd.store = store
+    if peers:
+        from ..replicate import attach_replication
+        self_id = f"127.0.0.1:{httpd.server_address[1]}"
+        node = attach_replication(httpd, self_id,
+                                  [p for p in peers if p != self_id],
+                                  **(replicate_opts or {}))
+        node.start()
     store.start_flusher()
     return httpd
 
 
 class SyncClient:
-    """Client-side replica (reference: wiki/client/dt_doc.ts:40-171)."""
+    """Client-side replica (reference: wiki/client/dt_doc.ts:40-171).
 
-    def __init__(self, base_url: str, doc_id: str, agent_name: str) -> None:
+    Transport errors on pull/push are retried `retries` times with the
+    jittered exponential `Backoff` shared with the peer mesh
+    (replicate/peers.py) — transient connection drops and HTTP 5xx are
+    retried, 4xx application rejections raise immediately. Both
+    operations are idempotent (summary-driven patch exchange), so a
+    retry after a response lost mid-flight is harmless."""
+
+    def __init__(self, base_url: str, doc_id: str, agent_name: str,
+                 retries: int = 3, timeout: float = 10.0) -> None:
         self.base = base_url.rstrip("/")
         self.doc_id = doc_id
+        self.retries = retries
+        self.timeout = timeout
         self.oplog = OpLog()
         self.oplog.doc_id = doc_id
         self.agent = self.oplog.get_or_create_agent_id(agent_name)
@@ -833,22 +943,30 @@ class SyncClient:
     def _url(self, action: str) -> str:
         return f"{self.base}/doc/{self.doc_id}/{action}"
 
+    def _fetch(self, action: str, data: Optional[bytes] = None) -> bytes:
+        from ..replicate.peers import Backoff, call_with_retries
+        req = urllib.request.Request(self._url(action), data=data)
+
+        def once() -> bytes:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.read()
+
+        return call_with_retries(
+            once, retries=self.retries,
+            backoff=Backoff(base_s=0.05, cap_s=1.0,
+                            key=f"{self.doc_id}/{action}"))
+
     def pull(self) -> None:
         summary = json.dumps(summarize_versions(self.oplog.cg)).encode("utf8")
-        req = urllib.request.Request(self._url("pull"), data=summary)
-        with urllib.request.urlopen(req) as r:
-            patch = r.read()
+        patch = self._fetch("pull", data=summary)
         decode_into(self.oplog, patch)
         self.branch.merge(self.oplog, self.oplog.version)
 
     def push(self) -> None:
-        summary_req = urllib.request.Request(self._url("summary"))
-        with urllib.request.urlopen(summary_req) as r:
-            server_summary = json.loads(r.read())
+        server_summary = json.loads(self._fetch("summary"))
         common, _ = intersect_with_summary(self.oplog.cg, server_summary)
         patch = encode_oplog(self.oplog, ENCODE_PATCH, from_version=common)
-        req = urllib.request.Request(self._url("push"), data=patch)
-        urllib.request.urlopen(req).read()
+        self._fetch("push", data=patch)
 
     def sync(self) -> None:
         self.push()
@@ -871,9 +989,21 @@ def main() -> None:
     p.add_argument("--serve-shards", type=int, default=0,
                    help="enable the sharded merge scheduler with N "
                    "host-engine shards (0 = off); metrics at /metrics")
+    p.add_argument("--peers", default=None,
+                   help="comma-separated host:port list of the full "
+                   "replication mesh (this server's own address is "
+                   "dropped); enables doc-ownership leases, mutation "
+                   "proxying and anti-entropy")
+    p.add_argument("--lease-ttl", type=float, default=2.0,
+                   help="doc-ownership lease TTL in seconds")
     args = p.parse_args()
-    httpd = serve(args.port, args.data_dir, serve_shards=args.serve_shards)
-    print(f"serving on http://127.0.0.1:{args.port}")
+    peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
+        if args.peers else None
+    httpd = serve(args.port, args.data_dir,
+                  serve_shards=args.serve_shards, peers=peers,
+                  replicate_opts={"lease_ttl_s": args.lease_ttl})
+    print(f"serving on http://127.0.0.1:{args.port}"
+          + (f" (mesh: {','.join(peers)})" if peers else ""))
     httpd.serve_forever()
 
 

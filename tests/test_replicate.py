@@ -1,0 +1,429 @@
+"""Cross-host replication tests: peer mesh, leases, anti-entropy,
+fault injection (diamond_types_tpu/replicate/). Tier-1 safe: every
+server is in-process on an ephemeral localhost port, no TPU, no
+background control-plane threads (tests step probes/rounds inline for
+determinism)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from diamond_types_tpu.replicate import (Backoff, FaultDrop,
+                                         FaultInjector,
+                                         attach_replication,
+                                         call_with_retries, owner_of)
+from diamond_types_tpu.replicate.ownership import (ACTIVE, GRANTED,
+                                                   LeaseManager)
+
+pytestmark = pytest.mark.replicate
+
+
+# ---- helpers -------------------------------------------------------------
+
+def _mesh(n, tmp_path=None, serve_shards=2, faults=None,
+          lease_ttl_s=5.0, **opts):
+    """N wired in-process servers. Returns (httpds, nodes, addrs).
+    Breaker backoff is tightened so circuits opened by injected faults
+    half-open within one paced test round instead of seconds."""
+    from diamond_types_tpu.tools.server import serve
+    opts.setdefault("backoff_base_s", 0.01)
+    opts.setdefault("backoff_cap_s", 0.05)
+    httpds, addrs = [], []
+    for i in range(n):
+        data_dir = str(tmp_path / f"s{i}") if tmp_path else None
+        httpd = serve(port=0, data_dir=data_dir,
+                      serve_shards=serve_shards)
+        httpds.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    nodes = []
+    for i, httpd in enumerate(httpds):
+        nodes.append(attach_replication(
+            httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+            faults=faults, lease_ttl_s=lease_ttl_s, **opts))
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+    return httpds, nodes, addrs
+
+
+def _teardown(httpds):
+    for h in httpds:
+        h.shutdown()
+        h.server_close()
+
+
+def _step(nodes, rounds=1):
+    for _ in range(rounds):
+        for n in nodes:
+            n.table.probe_once()
+            n.maintain()
+        for n in nodes:
+            n.antientropy.run_round()
+
+
+def _text(addr, doc):
+    with urllib.request.urlopen(f"http://{addr}/doc/{doc}",
+                                timeout=5) as r:
+        return r.read().decode("utf8")
+
+
+def _metrics(addr):
+    with urllib.request.urlopen(f"http://{addr}/metrics",
+                                timeout=5) as r:
+        return json.loads(r.read())
+
+
+# ---- unit: backoff / retries / faults ------------------------------------
+
+def test_backoff_deterministic_and_bounded():
+    a = Backoff(base_s=0.1, cap_s=2.0, seed=3, key="x")
+    b = Backoff(base_s=0.1, cap_s=2.0, seed=3, key="x")
+    da = [a.delay(i) for i in range(12)]
+    db = [b.delay(i) for i in range(12)]
+    assert da == db                       # seeded: replays exactly
+    assert all(0.05 <= d <= 2.0 for d in da)   # jitter in [0.5,1.0)*nominal
+    assert da[0] < 0.1 <= da[4]           # actually grows
+    # huge attempts must not overflow (DocStore backoff regression class)
+    assert 1.0 <= Backoff(base_s=0.1, cap_s=2.0).delay(5000) <= 2.0
+
+
+def test_call_with_retries_transient_vs_client_error():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert call_with_retries(flaky, retries=3,
+                             sleep=lambda s: None) == "ok"
+    assert len(calls) == 3
+
+    def always_fails():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        call_with_retries(always_fails, retries=2, sleep=lambda s: None)
+
+    n4xx = []
+
+    def client_error():
+        n4xx.append(1)
+        raise urllib.error.HTTPError("u", 400, "bad", {}, None)
+
+    with pytest.raises(urllib.error.HTTPError):
+        call_with_retries(client_error, retries=3, sleep=lambda s: None)
+    assert len(n4xx) == 1                 # 4xx: no retry
+
+
+def test_fault_injector_deterministic_and_partition():
+    a = FaultInjector(seed=11, drop_rate=0.3, dup_rate=0.2)
+    b = FaultInjector(seed=11, drop_rate=0.3, dup_rate=0.2)
+
+    def schedule(inj):
+        out = []
+        for _ in range(40):
+            try:
+                out.append("dup" if inj.before_call("x", "y") else "ok")
+            except FaultDrop:
+                out.append("drop")
+        return out
+
+    sa, sb = schedule(a), schedule(b)
+    assert sa == sb and "drop" in sa and "ok" in sa
+    inj = FaultInjector(seed=0)
+    inj.partition("a", "b")
+    with pytest.raises(FaultDrop):
+        inj.before_call("a", "b")
+    with pytest.raises(FaultDrop):
+        inj.before_call("b", "a")         # partitions are bidirectional
+    inj.before_call("a", "c")             # unrelated link unaffected
+    inj.heal("a", "b")
+    inj.before_call("a", "b")
+    assert inj.snapshot()["partition_blocks"] == 2
+
+
+# ---- unit: ownership -----------------------------------------------------
+
+def test_owner_rendezvous_process_independent():
+    hosts = ["127.0.0.1:8001", "127.0.0.1:8002", "127.0.0.1:8003"]
+    # pinned: blake2b rendezvous must never drift across processes/PRs
+    assert {d: owner_of(d, hosts) for d in
+            ("doc-0", "doc-1", "doc-2", "doc-3", "doc-4", "doc-5")} == {
+        "doc-0": "127.0.0.1:8001", "doc-1": "127.0.0.1:8001",
+        "doc-2": "127.0.0.1:8001", "doc-3": "127.0.0.1:8003",
+        "doc-4": "127.0.0.1:8003", "doc-5": "127.0.0.1:8001"}
+    # order-independent, and removing a non-owner never moves a doc
+    assert owner_of("doc-3", list(reversed(hosts))) == "127.0.0.1:8003"
+    assert owner_of("doc-3", ["127.0.0.1:8002", "127.0.0.1:8003"]) \
+        == "127.0.0.1:8003"
+
+
+def test_lease_state_machine_and_takeover():
+    a = LeaseManager("hostA", ttl_s=60.0)
+    b = LeaseManager("hostB", ttl_s=60.0)
+    # desired owner acquires; non-desired host never does
+    assert a.ensure_local("d", True)
+    assert not b.ensure_local("d", False)
+    assert a.get("d").state == ACTIVE and a.get("d").epoch == 1
+    # B learns A's live lease -> even as desired owner it must wait
+    b.observe_remote("d", "hostA", 1, ACTIVE, ttl_s=60.0)
+    assert not b.ensure_local("d", True)
+    # ... until the lease expires: takeover bumps the epoch
+    b.observe_remote("d", "hostA", 2, ACTIVE, ttl_s=0.0)
+    assert b.ensure_local("d", True)
+    assert b.get("d").epoch == 3 and b.get("d").holder == "hostB"
+    # handoff sender walk: ACTIVE -> GRANTING -> ... -> RELEASED
+    epoch = a.begin_handoff("d")
+    assert epoch == 2
+    assert not a.ensure_local("d", True)     # no merges mid-handoff
+    a.abort_handoff("d")
+    assert a.ensure_local("d", True)         # rollback restores ACTIVE
+    # receiver side: grant is not active until activated
+    assert b.accept_grant("e", 5, ttl_s=60.0)
+    assert b.get("e").state == GRANTED
+    assert not b.ensure_local("e", True)
+    assert b.activate_grant("e", 5)
+    assert b.activate_grant("e", 5)          # idempotent
+    assert not b.activate_grant("e", 4)      # stale epoch refused
+    assert b.ensure_local("e", True)
+
+
+# ---- integration: two-server smoke (tier-1 gate) -------------------------
+
+def test_two_server_smoke(tmp_path):
+    """Two wired servers: ownership proxy routes mutations, anti-entropy
+    converges the pair, /metrics exposes replication counters + the
+    serve schema v2 fields on both servers."""
+    from diamond_types_tpu.tools.server import SyncClient
+    httpds, nodes, addrs = _mesh(2, tmp_path)
+    try:
+        docs = ["alpha", "beta", "gamma"]
+        for i, doc in enumerate(docs):
+            c = SyncClient(f"http://{addrs[i % 2]}", doc, f"u{i}")
+            c.insert(0, f"content of {doc}. ")
+            c.sync()
+        _step(nodes, rounds=2)
+        for doc in docs:
+            texts = {_text(a, doc) for a in addrs}
+            assert len(texts) == 1, f"{doc} diverged: {texts}"
+        # merges ran only on each doc's (unique) lease holder
+        for doc in docs:
+            mergers = [n.self_id for n in nodes
+                       if doc in n.merged_docs]
+            assert len(mergers) <= 1
+            holder = nodes[0].leases.holder_of(doc)
+            if mergers:
+                assert mergers == [holder]
+        for a in addrs:
+            m = _metrics(a)
+            assert m["replication"]["version"] == 1
+            assert m["replication"]["leases"]["held"] >= 0
+            assert m["replication"]["antientropy"]["rounds"] >= 1
+            assert m["serve"]["version"] == 2
+            assert m["serve"]["uptime_s"] >= 0
+            assert "denied" in m["serve"]["totals"]
+        # ping endpoint serves health probes
+        with urllib.request.urlopen(
+                f"http://{addrs[0]}/replicate/ping", timeout=5) as r:
+            ping = json.loads(r.read())
+        assert ping["ok"] and ping["id"] == addrs[0]
+    finally:
+        _teardown(httpds)
+
+
+def test_mutation_proxy_routes_to_owner():
+    from diamond_types_tpu.tools.server import SyncClient
+    httpds, nodes, addrs = _mesh(2, serve_shards=2)
+    try:
+        doc = "proxied-doc"
+        owner = nodes[0].desired_owner(doc)
+        other = next(i for i, a in enumerate(addrs) if a != owner)
+        c = SyncClient(f"http://{addrs[other]}", doc, "writer")
+        c.insert(0, "written at the wrong server")
+        c.sync()
+        # the push was proxied: the OWNER admitted the merge, the
+        # receiving server did not
+        owner_node = next(n for n in nodes if n.self_id == owner)
+        other_node = next(n for n in nodes if n.self_id != owner)
+        assert doc in owner_node.merged_docs
+        assert doc not in other_node.merged_docs
+        assert other_node.metrics_json()["proxy"]["proxied"] >= 1
+        # and the owner actually stores the doc without anti-entropy
+        assert "wrong server" in _text(owner, doc)
+    finally:
+        _teardown(httpds)
+
+
+def test_explicit_handoff_moves_active_merger():
+    from diamond_types_tpu.tools.server import SyncClient
+    httpds, nodes, addrs = _mesh(2, serve_shards=2)
+    try:
+        doc = "handoff-doc"
+        owner = nodes[0].desired_owner(doc)
+        src = next(n for n in nodes if n.self_id == owner)
+        dst = next(n for n in nodes if n.self_id != owner)
+        c = SyncClient(f"http://{owner}", doc, "writer")
+        c.insert(0, "pre-handoff state")
+        c.sync()
+        assert src.owns(doc) and not dst.owns(doc)
+        epoch_before = src.leases.get(doc).epoch
+        assert src.handoff(doc, dst.self_id)
+        # dst now holds the ACTIVE lease at a higher epoch; src released
+        assert dst.leases.get(doc).state == ACTIVE
+        assert dst.leases.get(doc).epoch == epoch_before + 1
+        assert dst.owns(doc)
+        assert not src.owns(doc)
+        # the final patch transfer carried the doc bytes
+        assert "pre-handoff" in _text(dst.self_id, doc)
+        hm = src.metrics_json()["handoffs"]
+        assert hm["completed"] == 1 and hm["latency_s_total"] > 0
+    finally:
+        _teardown(httpds)
+
+
+def test_circuit_breaker_opens_and_recovers():
+    httpds, nodes, addrs = _mesh(2, serve_shards=0)
+    try:
+        n0 = nodes[0]
+        faults = FaultInjector(seed=1, drop_rate=1.0)   # kill the link
+        n0.table.faults = faults
+        for _ in range(n0.table.fail_threshold):
+            n0.table.probe_once()
+        assert not n0.table.is_healthy(addrs[1])
+        assert n0.table.healthy_ids() == [addrs[0]]
+        st = n0.table.state(addrs[1])
+        assert st["circuit_open"] and st["consecutive_failures"] >= 3
+        # ownership does NOT reassign while the outage is shorter than
+        # the takeover delay (a short partition must not create a
+        # second self-appointed owner) ...
+        assert n0.takeover_after_s == 5.0     # defaults to lease TTL
+        assert n0.ownership_ids() == sorted(addrs)
+        # ... but once the holder's lease has provably expired, the
+        # docs collapse onto the lone healthy host
+        n0.takeover_after_s = 0.0
+        assert n0.ownership_ids() == [addrs[0]]
+        assert n0.desired_owner("any-doc") == addrs[0]
+        n0.takeover_after_s = 5.0
+        # heal: backoff window must lapse before the half-open probe
+        n0.table.faults = None
+        deadline = __import__("time").monotonic() + 10
+        while not n0.table.is_healthy(addrs[1]):
+            n0.table.probe_once()
+            assert __import__("time").monotonic() < deadline
+        assert n0.table.state(addrs[1])["consecutive_failures"] == 0
+        m = n0.metrics_json()["probes"]
+        assert m["circuit_opens"] == 1 and m["circuit_closes"] == 1
+    finally:
+        _teardown(httpds)
+
+
+def test_syncclient_retries_transient_failures(monkeypatch):
+    """Satellite: SyncClient survives transient connection failures on
+    pull/push via the shared backoff helper."""
+    from diamond_types_tpu.tools import server as srv
+    httpd = srv.serve(port=0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        real_urlopen = urllib.request.urlopen
+        fail = {"n": 2}
+
+        def flaky_urlopen(req, timeout=None):
+            if fail["n"] > 0:
+                fail["n"] -= 1
+                raise ConnectionResetError("injected")
+            return real_urlopen(req, timeout=timeout)
+
+        monkeypatch.setattr(srv.urllib.request, "urlopen",
+                            flaky_urlopen)
+        c = srv.SyncClient(f"http://127.0.0.1:{port}", "retry-doc",
+                           "amy", retries=3)
+        c.insert(0, "survives flaky transport")
+        c.sync()                      # would raise without retry
+        fail["n"] = 2
+        c.pull()
+        assert c.text() == "survives flaky transport"
+        # retries exhausted -> the error still surfaces
+        fail["n"] = 99
+        c.insert(0, "x")
+        with pytest.raises(OSError):
+            c.push()
+    finally:
+        _teardown([httpd])
+
+
+# ---- acceptance: convergence under faults --------------------------------
+
+def test_convergence_under_faults(tmp_path):
+    """ISSUE acceptance: two in-process servers with injected faults
+    (drops + a healed partition, fixed seed) end byte-identical on
+    every doc, each doc's merges ran only on its lease holder, and
+    GET /metrics exposes the replication counters on both servers."""
+    from diamond_types_tpu.tools.server import SyncClient
+    faults = FaultInjector(seed=1234, drop_rate=0.25, dup_rate=0.1)
+    httpds, nodes, addrs = _mesh(2, tmp_path, serve_shards=2,
+                                 faults=faults)
+    try:
+        docs = ["conv-0", "conv-1", "conv-2"]
+        clients = {(i, d): SyncClient(f"http://{addrs[i]}", d,
+                                      f"w{i}-{d}", retries=1)
+                   for i in range(2) for d in docs}
+
+        def edit(i, d, text):
+            c = clients[(i, d)]
+            try:
+                c.pull()
+            except OSError:
+                pass
+            c.insert(0, text)
+            try:
+                c.sync()
+            except OSError:
+                pass          # dropped mid-fault; reconciled later
+
+        for i, d in [(0, docs[0]), (1, docs[1]), (0, docs[2])]:
+            edit(i, d, f"seed {d}. ")
+        _step(nodes)
+        # partition the pair; both sides keep writing every doc
+        faults.partition(addrs[0], addrs[1])
+        for r in range(3):
+            for d in docs:
+                edit(0, d, f"left{r} ")
+                edit(1, d, f"right{r} ")
+            _step(nodes)
+        faults.heal()
+        # reconcile to convergence (bounded; fixed seed keeps it
+        # tight). Paced so breaker backoff windows opened during the
+        # partition can lapse between rounds.
+        import time
+        for _ in range(10):
+            time.sleep(0.06)
+            _step(nodes)
+            if all(len({_text(a, d) for a in addrs}) == 1
+                   for d in docs):
+                break
+        for d in docs:
+            texts = {a: _text(a, d) for a in addrs}
+            assert len(set(texts.values())) == 1, \
+                f"{d} diverged: {texts}"
+            assert "left" in texts[addrs[0]] \
+                and "right" in texts[addrs[0]]
+        # owner-only merges: at most one host ever admitted each doc
+        for d in docs:
+            mergers = [n.self_id for n in nodes if d in n.merged_docs]
+            assert len(mergers) <= 1, f"{d} merged on {mergers}"
+            if mergers:
+                assert mergers[0] == nodes[0].desired_owner(d)
+        # both servers expose the replication counters, and the fault
+        # schedule actually exercised the mesh
+        for a in addrs:
+            rm = _metrics(a)["replication"]
+            assert rm["antientropy"]["rounds"] >= 4
+            assert rm["faults"]["drops"] >= 1
+        assert faults.snapshot()["partition_blocks"] >= 1
+    finally:
+        _teardown(httpds)

@@ -71,6 +71,38 @@ def test_router_rebalance_moves_only_required_subset():
     assert all(r2.shard_of(d) == before[d] for d in ids)
 
 
+def test_router_rebalance_pinned_assignments():
+    """Pinned blake2b rendezvous placements: these exact values must
+    hold in every process and across PRs — replication's doc-ownership
+    (replicate/ownership.py) derives host placement from the same
+    scoring, so silent drift here would strand leases cluster-wide."""
+    docs = [f"doc-{i}" for i in range(12)]
+    pinned = {
+        8: [5, 7, 1, 5, 6, 3, 6, 0, 7, 7, 6, 5],
+        5: [2, 3, 1, 2, 3, 3, 0, 0, 4, 0, 2, 4],
+        3: [2, 0, 1, 2, 0, 0, 0, 0, 0, 0, 2, 0],
+    }
+    for n, want in pinned.items():
+        assert [ShardRouter(n).shard_of(d) for d in docs] == want
+    # minimal rendezvous delta on shrink: exactly the docs whose top
+    # shard was removed (8-shard placement >= 5) move, nobody else
+    r = ShardRouter(8)
+    for d in docs:
+        r.assign(d)
+    moved = r.rebalance(5)
+    assert sorted(moved) == sorted(d for d, s in zip(docs, pinned[8])
+                                   if s >= 5)
+    for d, (old, new) in moved.items():
+        assert old == pinned[8][docs.index(d)]
+        assert new == pinned[5][docs.index(d)]
+    for d in docs:
+        assert r.assignments[d] == pinned[5][docs.index(d)]
+    # growing back is a clean inverse: the same set returns home
+    moved_back = r.rebalance(8)
+    assert sorted(moved_back) == sorted(moved)
+    assert [r.assignments[d] for d in docs] == pinned[8]
+
+
 # ---- admission queue ------------------------------------------------------
 
 def test_shape_bucket_pow2():
