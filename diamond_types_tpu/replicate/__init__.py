@@ -9,29 +9,41 @@ peer is just another sync client with a lease protocol on top.
 
 Layers (each its own module, composed by `node.ReplicaNode`):
 
-  peers.py        static peer table, health probes, consecutive-failure
-                  circuit breaker, jittered exponential `Backoff`,
-                  timeout on every HTTP call
+  peers.py        peer table (seeded + dynamic add/remove), health
+                  probes, consecutive-failure circuit breaker,
+                  jittered exponential `Backoff`, gossip piggyback on
+                  ping, timeout on every HTTP call
+  membership.py   dynamic membership view: join/leave/suspect/dead
+                  states, incarnation refutation, the rendezvous
+                  universe and the quorum voter set
   ownership.py    doc-ownership leases on top of rendezvous placement
                   extended to hosts (same blake2b scheme as
-                  serve/router.py) with an explicit handoff protocol
+                  serve/router.py), epoch fencing floors, the voter
+                  promise table, and an explicit handoff protocol
+  quorum.py       majority promise rounds (at most one ACTIVE lease
+                  per (doc, epoch)) + the crash-durable ReplicaJournal
+                  on the storage/ Wal + PageStore primitives
   antientropy.py  background reconciliation: summary exchange + binary
                   patch pull/push for divergent docs
   faults.py       deterministic fault injection (drop / delay /
-                  duplicate / partition by seed) for tests + soak
+                  duplicate / asymmetric partition / link latency /
+                  clock skew, by seed) for tests + soak
   metrics.py      replication counters merged into `GET /metrics`
   node.py         ReplicaNode — wires the above to a DocStore
   soak.py         in-process N-server soak driver (`cli replicate-soak`)
 """
 
 from .faults import FaultDrop, FaultInjector
+from .membership import MembershipView
 from .metrics import ReplicationMetrics
 from .node import ReplicaNode, attach_replication
 from .ownership import LeaseManager, owner_of
 from .peers import Backoff, CircuitOpen, PeerTable, call_with_retries
+from .quorum import QuorumCoordinator, ReplicaJournal
 
 __all__ = [
     "Backoff", "CircuitOpen", "FaultDrop", "FaultInjector",
-    "LeaseManager", "PeerTable", "ReplicaNode", "ReplicationMetrics",
+    "LeaseManager", "MembershipView", "PeerTable", "QuorumCoordinator",
+    "ReplicaJournal", "ReplicaNode", "ReplicationMetrics",
     "attach_replication", "call_with_retries", "owner_of",
 ]

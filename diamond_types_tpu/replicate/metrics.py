@@ -5,21 +5,34 @@ small lock, recording never touches the network or the device. The
 snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
-Schema (snapshot()):
+Schema (snapshot()) — v2 adds the quorum / fencing / membership groups
+and `leases.tie_breaks` (the partition-safety PR):
 
-  {"version": 1, "self": "host:port",
+  {"version": 2, "self": "host:port",
    "leases": {"held", "acquires", "renewals", "takeovers", "releases",
-              "churn"},             # churn = acquires+takeovers+releases
+              "tie_breaks",        # equal-epoch conflicts arbitrated
+              "churn"},            # churn = acquires+takeovers+releases
    "handoffs": {"started", "completed", "failed",
                 "latency_s_total", "latency_s_max"},
    "antientropy": {"rounds", "docs_checked", "docs_pulled",
                    "docs_pushed", "bytes_pulled", "bytes_pushed",
                    "errors"},
-   "proxy": {"proxied", "fallback_local", "loops_refused"},
+   "proxy": {"proxied", "fallback_local", "loops_refused",
+             "fenced_relays"},     # 409-fenced proxies retried locally
    "merge_gate": {"admits", "denials"},
    "probes": {"ok", "failed", "circuit_opens", "circuit_closes"},
+   "quorum": {"proposals", "acks", "denials", "rounds_won",
+              "rounds_lost", "promise_conflicts",
+              "rejoins_completed"},
+   "fencing": {"rejected_writes",       # proxied writes 409'd as stale
+               "stale_lease_revoked",   # own ACTIVE lease below floor
+               "rejoin_denials"},       # merges denied while rejoining
+   "membership": {"joins", "leaves", "suspicions", "refutations",
+                  "deaths"},
    "per_peer": {peer_id: {"consecutive_failures", "circuit_open",
                           "backoff_s", "last_ok_age_s"}},
+   "membership_view": {"view_version", "members": {...}} | null,
+   "quorum_view": {"voters", "quorum", "rejoining"} | null,
    "faults": injector counters | null}
 """
 
@@ -29,19 +42,30 @@ import threading
 from typing import Dict
 
 _GROUPS = {
-    "leases": ("acquires", "renewals", "takeovers", "releases"),
+    "leases": ("acquires", "renewals", "takeovers", "releases",
+               "tie_breaks"),
     "handoffs": ("started", "completed", "failed"),
     "antientropy": ("rounds", "docs_checked", "docs_pulled",
                     "docs_pushed", "bytes_pulled", "bytes_pushed",
                     "errors"),
-    "proxy": ("proxied", "fallback_local", "loops_refused"),
+    "proxy": ("proxied", "fallback_local", "loops_refused",
+              "fenced_relays"),
     "merge_gate": ("admits", "denials"),
     "probes": ("ok", "failed", "circuit_opens", "circuit_closes"),
+    "quorum": ("proposals", "acks", "denials", "rounds_won",
+               "rounds_lost", "promise_conflicts",
+               "rejoins_completed"),
+    "fencing": ("rejected_writes", "stale_lease_revoked",
+                "rejoin_denials"),
+    "membership": ("joins", "leaves", "suspicions", "refutations",
+                   "deaths"),
 }
 
 
 class ReplicationMetrics:
-    SCHEMA_VERSION = 1
+    # v1 -> v2: quorum / fencing / membership groups, leases.tie_breaks,
+    # proxy.fenced_relays, membership_view + quorum_view objects
+    SCHEMA_VERSION = 2
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id
@@ -55,6 +79,10 @@ class ReplicationMetrics:
         with self._lock:
             self._c[group][key] += n
 
+    def get(self, group: str, key: str) -> int:
+        with self._lock:
+            return self._c[group][key]
+
     def observe_handoff_latency(self, seconds: float) -> None:
         with self._lock:
             self._handoff_latency_total += seconds
@@ -62,7 +90,8 @@ class ReplicationMetrics:
                 self._handoff_latency_max = seconds
 
     def snapshot(self, leases_held: int = 0, per_peer: dict = None,
-                 faults: dict = None) -> dict:
+                 faults: dict = None, membership_view: dict = None,
+                 quorum_view: dict = None) -> dict:
         with self._lock:
             leases = dict(self._c["leases"])
             leases["held"] = leases_held
@@ -82,6 +111,11 @@ class ReplicationMetrics:
                 "proxy": dict(self._c["proxy"]),
                 "merge_gate": dict(self._c["merge_gate"]),
                 "probes": dict(self._c["probes"]),
+                "quorum": dict(self._c["quorum"]),
+                "fencing": dict(self._c["fencing"]),
+                "membership": dict(self._c["membership"]),
                 "per_peer": per_peer or {},
+                "membership_view": membership_view,
+                "quorum_view": quorum_view,
                 "faults": faults,
             }

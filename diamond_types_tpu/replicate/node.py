@@ -1,28 +1,56 @@
 """ReplicaNode: one server's membership in the replication mesh.
 
-Composes the peer table (health), lease manager (ownership), and
+Composes the peer table (health), membership view (who is in the
+mesh), lease manager (ownership + quorum voter state), quorum
+coordinator (majority rounds), replica journal (crash durability) and
 anti-entropy loop (convergence) around a DocStore, and implements the
-two protocols the HTTP tier delegates to it:
+protocols the HTTP tier delegates to it:
 
   * mutation routing — `route_mutation(doc_id)` names the host that
     should apply a write (current lease holder when known and healthy,
     rendezvous owner otherwise); `proxy()` forwards the raw request
-    body there. When the target is unreachable the server falls back
-    to accepting locally (availability over placement — the edit lands
-    in the local oplog, anti-entropy reconciles it later, and the
-    merge gate keeps device work off this host);
+    body there, stamping the lease epoch it routed by as a fencing
+    token (`X-DT-Lease-Epoch`). A receiver whose fencing floor has
+    passed that epoch answers 409 — the write is NOT merged under a
+    stale lease; the proxier falls back to accepting locally and
+    anti-entropy reconciles once the new epoch propagates. When the
+    target is simply unreachable the server also falls back to
+    accepting locally (availability over placement);
+
+  * quorum — lease acquisition, takeover, and handoff activation all
+    run the promise round (quorum.QuorumCoordinator) against the
+    membership voter set before a lease becomes ACTIVE;
+
+  * membership — `/replicate/join` and `/replicate/leave` mutate the
+    view explicitly; the probe loop feeds local health evidence into
+    it and gossips member tables on every ping (peers.PeerTable
+    `on_ping` hook). Rendezvous ownership is computed over
+    `membership.universe()`, so lease migration on view changes is
+    deterministic — every host recomputes the same owner from the
+    same view;
 
   * handoff — `handoff(doc_id, new_owner)` drives the sender side of
     the lease state machine (see ownership.py):
-    grant → drain pending merges → final patch transfer → activate.
+    grant → drain pending merges → final patch transfer → activate
+    (the receiver runs the quorum round for the new epoch before
+    flipping GRANTED → ACTIVE);
+
+  * crash recovery — when constructed with a `journal_prefix`, fencing
+    floors, promises, held leases and the membership incarnation are
+    journaled (quorum.ReplicaJournal). A restart restores them, bumps
+    the incarnation, and boots into a fenced `rejoining` state: every
+    merge admit is denied until a quorum of voters has been confirmed
+    reachable (`maintain` clears it), so a node that slept through a
+    takeover cannot merge under its pre-crash beliefs.
 
 `maintain()` is the periodic control step (piggybacked on the probe
-loop): renew held leases and hand off docs whose rendezvous owner moved
-(peer recovered, health view changed).
+loop): clear rejoining when earned, renew held leases, and hand off
+docs whose rendezvous owner moved (peer recovered, view changed).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 import urllib.error
@@ -32,9 +60,11 @@ from ..causalgraph.summary import intersect_with_summary
 from ..encoding.encode import ENCODE_PATCH, encode_oplog
 from .antientropy import AntiEntropy
 from .faults import FaultInjector
+from .membership import ALIVE, LEFT, MembershipView
 from .metrics import ReplicationMetrics
 from .ownership import DRAINING, TRANSFER, LeaseManager, owner_of
 from .peers import PeerTable
+from .quorum import QuorumCoordinator, ReplicaJournal
 
 MUTATION_ACTIONS = ("push", "edit", "ops")
 
@@ -48,13 +78,16 @@ class ReplicaNode:
                  backoff_base_s: float = 0.1,
                  backoff_cap_s: float = 5.0,
                  takeover_after_s: Optional[float] = None,
-                 faults: Optional[FaultInjector] = None) -> None:
+                 faults: Optional[FaultInjector] = None,
+                 journal_prefix: Optional[str] = None) -> None:
         self.store = store
         self.self_id = self_id
         self.started_at = time.monotonic()
-        # how long a peer must stay continuously down before ownership
-        # reassigns its docs; defaults to the lease TTL so a takeover
-        # can only happen after the old holder's lease has expired
+        # how long a peer must stay continuously down before it is
+        # declared DEAD and ownership reassigns its docs; defaults to
+        # the lease TTL so a takeover can only be PROPOSED after the
+        # old holder's lease has expired (the quorum round is what
+        # makes the proposal safe)
         self.takeover_after_s = (lease_ttl_s if takeover_after_s is None
                                  else takeover_after_s)
         self.metrics = ReplicationMetrics(self_id)
@@ -66,6 +99,25 @@ class ReplicaNode:
                                faults=faults, metrics=self.metrics)
         self.leases = LeaseManager(self_id, ttl_s=lease_ttl_s,
                                    metrics=self.metrics)
+        # ---- crash-restart restore ----
+        self.journal: Optional[ReplicaJournal] = None
+        self.rejoining = False
+        incarnation = 1
+        if journal_prefix is not None:
+            self.journal = ReplicaJournal(journal_prefix)
+            self.rejoining = self.journal.has_prior_state()
+            incarnation = self.journal.restored_incarnation() + 1
+            self.journal.note_incarnation(incarnation)
+            self.leases.restore(self.journal)
+        self.membership = MembershipView(self_id, incarnation,
+                                         metrics=self.metrics)
+        # bootstrap peers start ALIVE (assumed healthy until the probe
+        # loop says otherwise — same optimism the static table had)
+        for addr in self.table.peer_ids():
+            self.membership.add(addr, state=ALIVE)
+        self.quorum = QuorumCoordinator(self)
+        self.leases.quorum = self._run_quorum
+        self.table.on_ping = self._on_ping
         self.antientropy = AntiEntropy(
             self, interval_s=antientropy_interval_s)
         self.probe_interval_s = probe_interval_s
@@ -76,36 +128,61 @@ class ReplicaNode:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # ---- quorum hook -----------------------------------------------------
+
+    def _run_quorum(self, doc_id: str, epoch: int,
+                    takeover: bool) -> bool:
+        """LeaseManager's acquisition hook. A rejoining node may not
+        propose — it must first re-earn its place (maintain clears the
+        state once a quorum of voters is confirmed reachable)."""
+        if self.rejoining:
+            return False
+        return self.quorum.acquire(doc_id, epoch, takeover)
+
     # ---- ownership -------------------------------------------------------
 
-    def ownership_ids(self) -> List[str]:
-        """Hosts rendezvous ownership is computed over: self plus every
-        peer that is healthy OR has been down for less than
-        `takeover_after_s`. The delay means a short partition does not
-        collapse each side's host set to itself — both sides keep
-        computing the same owner, so exactly one host admits merges.
-        Only an outage longer than a lease TTL (holder's lease provably
-        expired) reassigns ownership."""
+    def _sync_membership(self) -> None:
+        """Fold the probe loop's health evidence into the membership
+        view: reachable → ALIVE, down < takeover_after_s → SUSPECT
+        (still in the rendezvous universe, so a short partition does
+        not collapse each side's host set to itself), down past it →
+        DEAD (out of the universe; its docs reassign — safely, because
+        reassignment still needs a quorum)."""
         now = time.monotonic()
-        ids = [self.self_id]
         for p in self.table.peer_ids():
-            d = self.table.down_duration(p, now)
-            if d is None or d < self.takeover_after_s:
-                ids.append(p)
-        return sorted(ids)
+            self.membership.note_health(
+                p, self.table.down_duration(p, now),
+                self.takeover_after_s)
+
+    def ownership_ids(self) -> List[str]:
+        """Hosts rendezvous ownership is computed over — the
+        membership universe (ALIVE + SUSPECT + JOINING, always
+        including self)."""
+        self._sync_membership()
+        return self.membership.universe()
 
     def desired_owner(self, doc_id: str) -> str:
         return owner_of(doc_id, self.ownership_ids())
 
     def owns(self, doc_id: str) -> bool:
         """The scheduler's merge-admission gate: True iff this host
-        holds (or may now acquire) the doc's ACTIVE lease."""
+        holds (or can now acquire, quorum permitting) the doc's ACTIVE
+        lease. Denied outright while rejoining after a crash."""
+        if self.rejoining:
+            self.metrics.bump("fencing", "rejoin_denials")
+            self.metrics.bump("merge_gate", "denials")
+            return False
         ok = self.leases.ensure_local(
             doc_id, self.desired_owner(doc_id) == self.self_id)
         self.metrics.bump("merge_gate", "admits" if ok else "denials")
         if ok:
             self.merged_docs.add(doc_id)
         return ok
+
+    def active_epoch(self, doc_id: str) -> int:
+        """Scheduler fencing callback: epoch of the ACTIVE lease this
+        host holds for the doc, 0 when it holds none."""
+        return self.leases.active_epoch(doc_id)
 
     def route_mutation(self, doc_id: str) -> str:
         """The host a write for `doc_id` should land on."""
@@ -117,31 +194,57 @@ class ReplicaNode:
 
     # ---- proxy -----------------------------------------------------------
 
-    def proxy(self, target: str, path: str,
-              body: bytes) -> Optional[Tuple[int, bytes]]:
-        """Forward a mutation to its owner. Returns (status, body) to
-        relay, or None when the owner is unreachable — the caller then
-        accepts locally (and anti-entropy reconciles)."""
+    def proxy(self, target: str, path: str, body: bytes,
+              doc_id: Optional[str] = None) -> Optional[Tuple[int, bytes]]:
+        """Forward a mutation to its owner, stamping the lease epoch we
+        routed by (the fencing token). Returns (status, body) to relay,
+        or None when the caller should accept locally instead: target
+        unreachable, or target fenced the epoch (our routing info was
+        stale — anti-entropy reconciles once the new lease propagates)."""
+        headers = {"X-DT-Proxied": "1"}
+        if doc_id is not None:
+            lease = self.leases.get(doc_id)
+            if lease is not None and lease.holder == target:
+                headers["X-DT-Lease-Epoch"] = str(lease.epoch)
         try:
-            status, resp = self.table.call(
-                target, path, data=body,
-                headers={"X-DT-Proxied": "1"})
+            status, resp = self.table.call(target, path, data=body,
+                                           headers=headers)
         except urllib.error.HTTPError as e:
             # owner answered with an application error: relay verbatim
             status, resp = e.code, e.read()
         except OSError:
             self.metrics.bump("proxy", "fallback_local")
             return None
+        if status == 409:
+            try:
+                fenced = json.loads(resp or b"{}").get("error") == "fenced"
+            except ValueError:
+                fenced = False
+            if fenced:
+                self.metrics.bump("proxy", "fenced_relays")
+                return None
         self.metrics.bump("proxy", "proxied")
         return status, resp
+
+    def check_write_fence(self, doc_id: str,
+                          claimed_epoch: int) -> bool:
+        """Receiver side of write fencing: may a proxied mutation
+        claiming `claimed_epoch` be applied to `doc_id`? False when the
+        fencing floor has passed the claim — the proxier routed by a
+        lease that has been superseded."""
+        if claimed_epoch >= self.leases.max_epoch_of(doc_id):
+            return True
+        self.metrics.bump("fencing", "rejected_writes")
+        return False
 
     # ---- handoff (sender) ------------------------------------------------
 
     def handoff(self, doc_id: str, new_owner: str) -> bool:
         """Move doc ownership to `new_owner` without ever having two
-        active mergers: grant → drain → final patch → activate. Any
-        failure aborts back to ACTIVE (the remote GRANTED lease simply
-        expires)."""
+        active mergers: grant → drain → final patch → activate (the
+        receiver's activate runs the quorum round for the new epoch).
+        Any failure aborts back to ACTIVE (the remote GRANTED lease
+        simply expires)."""
         t0 = time.monotonic()
         new_epoch = self.leases.begin_handoff(doc_id)
         if new_epoch is None:
@@ -178,7 +281,8 @@ class ReplicaNode:
             if patch is not None:
                 self.table.call(new_owner, f"/doc/{doc_id}/push",
                                 data=patch)
-            # activate: receiver flips GRANTED -> ACTIVE; we release
+            # activate: receiver runs the quorum round for new_epoch,
+            # then flips GRANTED -> ACTIVE; we release
             resp = self.table.call_json(
                 new_owner, "/replicate/lease",
                 {"action": "activate", "doc": doc_id,
@@ -203,28 +307,132 @@ class ReplicaNode:
         if not isinstance(doc_id, str) or not doc_id:
             return {"ok": False, "error": "bad doc"}
         epoch = int(req.get("epoch", 0))
+        if action == "propose":
+            holder = req.get("holder")
+            if not isinstance(holder, str) or not holder:
+                return {"ok": False, "error": "bad holder"}
+            ok, reason = self.leases.promise(doc_id, epoch, holder)
+            return {"ok": ok, "reason": reason,
+                    "max_epoch": self.leases.max_epoch_of(doc_id)}
         if action == "grant":
             ok = self.leases.accept_grant(
                 doc_id, epoch, float(req.get("ttl_s", 0.0)))
             return {"ok": ok}
         if action == "activate":
+            # the handoff's quorum round: the new epoch must win a
+            # majority before this node becomes the active merger
+            if not self._run_quorum(doc_id, epoch, False):
+                return {"ok": False, "error": "quorum"}
             ok = self.leases.activate_grant(doc_id, epoch)
             return {"ok": ok}
         if action == "status":
             lease = self.leases.get(doc_id)
             return {"ok": True,
                     "lease": lease.as_json() if lease else None,
-                    "desired": self.desired_owner(doc_id)}
+                    "desired": self.desired_owner(doc_id),
+                    "max_epoch": self.leases.max_epoch_of(doc_id),
+                    "rejoining": self.rejoining}
         return {"ok": False, "error": f"bad action {action!r}"}
+
+    # ---- membership wire handlers ----------------------------------------
+
+    def ping_json(self) -> dict:
+        """Body of `GET /replicate/ping` — health ack + gossip
+        piggyback (the probe loop is the gossip transport)."""
+        return {"ok": True, "id": self.self_id,
+                "uptime_s": round(time.monotonic() - self.started_at, 3),
+                "incarnation": self.membership.self_incarnation,
+                "view_version": self.membership.view_version,
+                "rejoining": self.rejoining,
+                "members": self.membership.gossip_payload()}
+
+    def _on_ping(self, peer_id: str, body: dict) -> None:
+        """Probe-loop gossip hook: fold the responder's member table,
+        and open transport to any member we just learned about."""
+        members = body.get("members")
+        if isinstance(members, dict):
+            self.membership.merge_remote(members)
+            for mid, info in members.items():
+                if isinstance(info, dict) \
+                        and info.get("state") != LEFT:
+                    self.table.add_peer(mid)
+
+    def handle_join(self, req: dict) -> dict:
+        """`POST /replicate/join` — a node announces itself (bootstrap
+        or re-join after restart, with a bumped incarnation). Gossip
+        spreads the new member from here; the response carries our
+        member table so the joiner learns the mesh in one round trip."""
+        member_id = req.get("id")
+        if not isinstance(member_id, str) or not member_id:
+            return {"ok": False, "error": "bad id"}
+        incarnation = int(req.get("incarnation", 0))
+        self.table.add_peer(member_id)
+        self.membership.add(member_id, state=ALIVE,
+                            incarnation=incarnation)
+        return {"ok": True, "self": self.self_id,
+                "members": self.membership.gossip_payload(),
+                "peers": self.table.all_ids()}
+
+    def handle_leave(self, req: dict) -> dict:
+        """`POST /replicate/leave` — explicit, operator-driven removal:
+        the ONLY operation that shrinks the quorum denominator."""
+        member_id = req.get("id")
+        if not isinstance(member_id, str) or not member_id:
+            return {"ok": False, "error": "bad id"}
+        left = self.membership.leave(member_id)
+        self.table.remove_peer(member_id)
+        return {"ok": True, "left": left}
+
+    def join_mesh(self, seed_addr: str) -> bool:
+        """Announce ourselves to `seed_addr` and adopt its view (used
+        by `serve --join` and the chaos soak's churn phase)."""
+        self.table.add_peer(seed_addr)
+        self.membership.add(seed_addr, state=ALIVE)
+        try:
+            resp = self.table.call_json(
+                seed_addr, "/replicate/join",
+                {"id": self.self_id,
+                 "incarnation": self.membership.self_incarnation})
+        except (OSError, urllib.error.HTTPError, ValueError):
+            return False
+        if not resp.get("ok"):
+            return False
+        members = resp.get("members")
+        if isinstance(members, dict):
+            self._on_ping(seed_addr, {"members": members})
+        return True
 
     # ---- periodic control ------------------------------------------------
 
+    def _rejoin_check(self) -> None:
+        """Clear the post-crash `rejoining` fence once a quorum of
+        voters is confirmed reachable (probed OK at least once, circuit
+        closed). Until then every merge admit is denied."""
+        if not self.rejoining:
+            return
+        confirmed = 1       # self
+        for v in self.membership.voters():
+            if v == self.self_id:
+                continue
+            st = self.table.peers.get(v)
+            if st is not None and st.last_ok is not None \
+                    and st.open_until == 0.0:
+                confirmed += 1
+        if confirmed >= self.membership.quorum_size():
+            self.rejoining = False
+            self.metrics.bump("quorum", "rejoins_completed")
+
     def maintain(self) -> dict:
-        """Renew held leases; hand off docs whose rendezvous owner
-        moved to a healthy peer. Serialized (probe loop + manual test
-        calls must not race two handoffs for one doc)."""
+        """Clear rejoining when earned; renew held leases; hand off
+        docs whose rendezvous owner moved to a healthy peer.
+        Serialized (probe loop + manual test calls must not race two
+        handoffs for one doc)."""
         out = {"renewed": 0, "handoffs": 0}
         with self._maintain_lock:
+            self._sync_membership()
+            self._rejoin_check()
+            if self.rejoining:
+                return out
             for doc_id in self.leases.held_ids():
                 desired = self.desired_owner(doc_id)
                 if desired == self.self_id:
@@ -255,7 +463,11 @@ class ReplicaNode:
             leases_held=self.leases.held_count(),
             per_peer=self.table.states(),
             faults=self.faults.snapshot()
-            if self.faults is not None else None)
+            if self.faults is not None else None,
+            membership_view=self.membership.as_json(),
+            quorum_view={"voters": self.membership.voters(),
+                         "quorum": self.membership.quorum_size(),
+                         "rejoining": self.rejoining})
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -284,18 +496,21 @@ class ReplicaNode:
             self._thread = None
         self._stop = threading.Event()
         self.table.stop_probe_loop()
+        if self.journal is not None:
+            self.journal.close()
 
 
 def attach_replication(httpd, self_id: str, peer_addrs: List[str],
                        **opts) -> ReplicaNode:
     """Wire a ReplicaNode onto a running server (tools/server.serve):
     the store gains `.replica`, and the merge scheduler (when present)
-    gets the ownership admit gate. Split from serve() because tests
-    bind port 0 first and only then know their own `host:port`
-    identity."""
+    gets the ownership admit gate plus the epoch fencing callback.
+    Split from serve() because tests bind port 0 first and only then
+    know their own `host:port` identity."""
     store = httpd.store
     node = ReplicaNode(store, self_id, peer_addrs, **opts)
     store.replica = node
     if getattr(store, "scheduler", None) is not None:
         store.scheduler.admit = node.owns
+        store.scheduler.epoch_of = node.active_epoch
     return node

@@ -1,9 +1,12 @@
-"""Static peer table + health tracking for the replication mesh.
+"""Peer table + health tracking for the replication mesh.
 
-Peers are fixed at startup (`--peers host:port,...`) — membership
-changes are a restart, not a gossip protocol; what changes at runtime
-is *health*. Every outbound HTTP call gets a hard timeout, failures
-feed a consecutive-failure circuit breaker, and re-probes back off with
+Peers are seeded at startup (`--peers host:port,...`) and can now
+change at runtime: `add_peer`/`remove_peer` back the /replicate/join
+and /replicate/leave endpoints, and the probe loop doubles as the
+gossip transport — each ping response body is handed to the `on_ping`
+hook, which membership.MembershipView uses to merge remote member
+tables. Every outbound HTTP call gets a hard timeout, failures feed a
+consecutive-failure circuit breaker, and re-probes back off with
 jittered exponential delays so a dead peer costs one cheap probe per
 backoff window instead of a timeout per request.
 
@@ -108,6 +111,12 @@ class PeerTable:
         self.fail_threshold = max(int(fail_threshold), 1)
         self.faults = faults
         self.metrics = metrics
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._seed = seed
+        # gossip hook: on_ping(peer_id, parsed_ping_body) — wired by
+        # ReplicaNode to fold the responder's member table
+        self.on_ping: Optional[Callable[[str, dict], None]] = None
         self._lock = threading.Lock()
         self.peers: Dict[str, _PeerState] = {}
         for addr in peer_addrs:
@@ -119,6 +128,25 @@ class PeerTable:
         self._probe_thread: Optional[threading.Thread] = None
 
     # ---- membership / health views ---------------------------------------
+
+    def add_peer(self, addr: str) -> bool:
+        """Register a peer discovered at runtime (join announcement or
+        gossip). Idempotent; never adds self."""
+        if not addr or addr == self.self_id:
+            return False
+        with self._lock:
+            if addr in self.peers:
+                return False
+            self.peers[addr] = _PeerState(
+                addr, Backoff(self._backoff_base_s, self._backoff_cap_s,
+                              seed=self._seed,
+                              key=f"{self.self_id}->{addr}"))
+            return True
+
+    def remove_peer(self, addr: str) -> bool:
+        """Drop a peer that explicitly left the mesh."""
+        with self._lock:
+            return self.peers.pop(addr, None) is not None
 
     def peer_ids(self) -> List[str]:
         return sorted(self.peers)
@@ -268,9 +296,13 @@ class PeerTable:
     # ---- probe loop ------------------------------------------------------
 
     def probe(self, peer_id: str) -> bool:
-        """One health probe (`GET /replicate/ping`). Returns up/down."""
+        """One health probe (`GET /replicate/ping`). Returns up/down.
+        A 200 body is parsed and handed to the `on_ping` gossip hook
+        (membership piggyback rides the probe loop for free)."""
+        body = b""
         try:
-            status, _ = self.call(peer_id, "/replicate/ping", probe=True)
+            status, body = self.call(peer_id, "/replicate/ping",
+                                     probe=True)
             ok = status == 200
         except CircuitOpen:
             return False        # still inside the backoff window
@@ -278,6 +310,11 @@ class PeerTable:
             ok = False
         if self.metrics is not None:
             self.metrics.bump("probes", "ok" if ok else "failed")
+        if ok and self.on_ping is not None:
+            try:
+                self.on_ping(peer_id, json.loads(body or b"{}"))
+            except (ValueError, TypeError):
+                pass            # malformed gossip never fails a probe
         return ok
 
     def probe_once(self) -> Dict[str, bool]:

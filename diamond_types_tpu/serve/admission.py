@@ -42,6 +42,11 @@ class PendingMerge:
     doc_id: str
     n_ops: int
     enqueued_at: float
+    # lease epoch under which the work was admitted (-1 = unfenced,
+    # single-host). The scheduler rechecks it at flush time: work
+    # admitted under a lease this host no longer holds is dropped, not
+    # merged (the new owner merges the same durable oplog instead).
+    epoch: int = -1
 
 
 class Backpressure(Exception):
@@ -84,15 +89,18 @@ class AdmissionQueue:
         return sum(len(w) for w in self._where)
 
     def submit(self, shard: int, doc_id: str, n_ops: int,
-               now: float) -> int:
+               now: float, epoch: int = -1) -> int:
         """Queue (or coalesce) `n_ops` of pending merge work for
         `doc_id`. Returns the shape bucket it landed in. Raises
-        Backpressure instead of exceeding `max_pending` docs/shard."""
+        Backpressure instead of exceeding `max_pending` docs/shard.
+        Coalescing adopts the LATEST lease epoch — earlier queued ops
+        are covered by the newer admit decision."""
         where = self._where[shard]
         old_bucket = where.get(doc_id)
         if old_bucket is not None:
             item = self._q[shard][old_bucket].pop(doc_id)
             item.n_ops += max(int(n_ops), 0)
+            item.epoch = epoch
             bucket = shape_bucket(item.n_ops)
             self._q[shard].setdefault(bucket, {})[doc_id] = item
             where[doc_id] = bucket
@@ -103,7 +111,7 @@ class AdmissionQueue:
             raise Backpressure(shard, len(where), self.flush_deadline_s)
         bucket = shape_bucket(n_ops)
         self._q[shard].setdefault(bucket, {})[doc_id] = PendingMerge(
-            doc_id, max(int(n_ops), 1), now)
+            doc_id, max(int(n_ops), 1), now, epoch)
         where[doc_id] = bucket
         return bucket
 

@@ -7,12 +7,12 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"version": 2,                   # counter-set schema; bump on change
+  {"version": 3,                   # counter-set schema; bump on change
    "uptime_s": s,                  # monotonic since construction
    "shards": N, "flush_docs": B,
-   "totals": {"submits", "coalesced", "rejects", "denied", "flushes",
-              "flushed_docs", "flushed_ops", "builds", "evictions",
-              "resyncs", "syncs", "host_fallbacks"},
+   "totals": {"submits", "coalesced", "rejects", "denied", "fenced",
+              "flushes", "flushed_docs", "flushed_ops", "builds",
+              "evictions", "resyncs", "syncs", "host_fallbacks"},
    "batch_occupancy": mean(flush size) / flush_docs,   # 0..1
    "host_fallback_ratio": host_fallbacks / max(syncs, 1),
    "flush_reasons": {"size": n, "deadline": n, "force": n},
@@ -31,16 +31,18 @@ import time
 from typing import Dict, List
 
 
-_SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "flushes",
-               "flushed_docs", "flushed_ops", "builds", "evictions",
-               "resyncs", "syncs", "host_fallbacks")
+_SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "fenced",
+               "flushes", "flushed_docs", "flushed_ops", "builds",
+               "evictions", "resyncs", "syncs", "host_fallbacks")
 
 
 class ServeMetrics:
     # bump whenever the counter set changes so bench/soak tooling can
-    # detect schema drift across PRs (satellite of the replication PR:
-    # v2 = uptime_s + version + the `denied` ownership-gate counter)
-    SCHEMA_VERSION = 2
+    # detect schema drift across PRs (v2 = uptime_s + version + the
+    # `denied` ownership-gate counter; v3 = `fenced`, queued work
+    # skipped at flush because its admit-time lease epoch is no longer
+    # the one this host holds)
+    SCHEMA_VERSION = 3
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:

@@ -21,7 +21,13 @@ Ownership gate: when `admit` is set (cross-host replication — a
 `replicate.ReplicaNode.owns` bound method), `submit` consults it first
 and refuses merge work for docs whose lease this host does not hold;
 the edit stays durable in the oplog, the device work runs on the
-lease-holding host instead.
+lease-holding host instead. When `epoch_of` is also set
+(`ReplicaNode.active_epoch`), each accepted submit is stamped with the
+lease epoch it was admitted under and RE-CHECKED at flush time: if the
+lease moved (or was fenced off) between admit and flush, the queued
+work is dropped — counted as `fenced` — instead of merged under a
+stale lease. The ops themselves stay durable in the oplog; the new
+owner merges them.
 """
 
 from __future__ import annotations
@@ -76,6 +82,9 @@ class MergeScheduler:
         # `admit(doc_id) -> bool` — the cross-host ownership gate
         # (replicate.ReplicaNode.owns); None = single-host, admit all
         self.admit = admit
+        # `epoch_of(doc_id) -> int` — the ACTIVE lease epoch this host
+        # holds (replicate.ReplicaNode.active_epoch); None = unfenced
+        self.epoch_of: Optional[Callable[[str], int]] = None
         self.lock = threading.Lock()
         self._shard_locks = [threading.Lock() for _ in range(n_shards)]
         self._pump_stop = threading.Event()
@@ -98,12 +107,16 @@ class MergeScheduler:
             self.metrics.bump(shard, "denied")
             return {"accepted": False, "shard": shard,
                     "reason": "not_owner"}
+        # stamp the admit-time lease epoch; the flush rechecks it
+        epoch = self.epoch_of(doc_id) if self.epoch_of is not None \
+            else -1
         with self.lock:
             shard = self.router.assign(doc_id)
             self.metrics.bump(shard, "submits")
             already = self.queue.pending_bucket(shard, doc_id) is not None
             try:
-                bucket = self.queue.submit(shard, doc_id, n_ops, now)
+                bucket = self.queue.submit(shard, doc_id, n_ops, now,
+                                           epoch=epoch)
             except Backpressure as bp:
                 self.metrics.bump(shard, "rejects")
                 return {"accepted": False, "shard": shard,
@@ -144,7 +157,21 @@ class MergeScheduler:
     def _flush_items(self, shard: int, reason: str, items) -> None:
         """Sync one taken batch into its shard's bank, under that
         shard's lock only (items are already off the queue, so a
-        concurrent submit for the same doc simply queues fresh work)."""
+        concurrent submit for the same doc simply queues fresh work).
+        The fencing recheck runs first: work admitted under a lease
+        epoch this host no longer holds is dropped (`fenced`), never
+        merged — its ops are still in the oplog for the new owner."""
+        if self.epoch_of is not None:
+            kept = []
+            for item in items:
+                if item.epoch != -1 \
+                        and self.epoch_of(item.doc_id) != item.epoch:
+                    self.metrics.bump(shard, "fenced")
+                else:
+                    kept.append(item)
+            items = kept
+            if not items:
+                return
         bank = self.banks[shard]
         with self._shard_locks[shard]:
             for item in items:

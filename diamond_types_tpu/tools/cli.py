@@ -282,6 +282,7 @@ def cmd_replicate_soak(args) -> int:
         partition_rounds=args.partition_rounds,
         reconcile_rounds=args.reconcile_rounds,
         lease_ttl_s=args.lease_ttl, serve_shards=args.serve_shards,
+        crash=args.crash, asym=args.asym, churn=args.churn,
         progress=args.progress)
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:
@@ -298,8 +299,14 @@ def cmd_replicate_soak(args) -> int:
               f"{'CONVERGED' if report['converged'] else 'DIVERGED'}"
               + (f" after {report['converged_after_reconcile_rounds']} "
                  f"reconcile rounds"
-                 if report["converged_after_reconcile_rounds"] else ""))
-    return 0 if report["converged"] else 1
+                 if report["converged_after_reconcile_rounds"] else "")
+              + (f", {report['crashes']} crash-restarts" if
+                 report["crashes"] else "")
+              + (", split-brain: "
+                 + ("NONE" if report["zero_split_brain"]
+                    else ",".join(report["split_brain"]))))
+    return 0 if report["converged"] and report["zero_split_brain"] \
+        else 1
 
 
 def main(argv=None) -> int:
@@ -397,6 +404,14 @@ def main(argv=None) -> int:
     c.add_argument("--serve-shards", type=int, default=0,
                    help="attach the host-engine merge scheduler with "
                    "N shards on every server (ownership-gated)")
+    c.add_argument("--crash", action="store_true",
+                   help="crash-restart two nodes mid-run (journal "
+                   "recovery + rejoining fence)")
+    c.add_argument("--asym", action="store_true",
+                   help="one-way partitions + jittered slow link + "
+                   "clock skew")
+    c.add_argument("--churn", action="store_true",
+                   help="join an extra node mid-run, then leave it")
     c.add_argument("--progress", action="store_true")
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")

@@ -51,23 +51,42 @@ web_assets.py for the pages):
 Replication tier (--peers host:port,... — diamond_types_tpu/replicate/;
 N server instances jointly own the document space):
 
-  GET  /replicate/ping      -> {"ok", "id", "uptime_s"} health probe
+  GET  /replicate/ping      -> {"ok", "id", "uptime_s", "incarnation",
+                            "view_version", "rejoining", "members"}
+                            — health probe + membership gossip
+                            piggyback (the probe loop is the gossip
+                            transport)
   GET  /replicate/docs      -> {"docs": {id: {"lease": {holder, epoch,
                             state, ttl_s} | null}}, "self"} — doc list
                             + piggybacked lease claims (anti-entropy)
-  POST /replicate/lease     body {"action": "grant"|"activate"|"status",
-                            "doc", "epoch", "ttl_s"?} -> {"ok": bool}
-                            — the handoff wire protocol (idempotent)
+  POST /replicate/lease     body {"action": "propose"|"grant"|
+                            "activate"|"status", "doc", "epoch",
+                            "holder"?, "ttl_s"?} -> {"ok": bool, ...}
+                            — the quorum + handoff wire protocol
+                            (idempotent); "propose" is the voter-side
+                            promise round (quorum.py)
+  POST /replicate/join      body {"id", "incarnation"} -> {"ok",
+                            "members", "peers"} — dynamic join; the
+                            response carries the responder's view so
+                            the joiner learns the mesh in one trip
+  POST /replicate/leave     body {"id"} -> {"ok"} — explicit removal
+                            (the only operation that shrinks the
+                            quorum denominator)
 
-  Ownership: rendezvous placement of docs over the healthy host set
-  (replicate/ownership.py) + leases; mutations (/push, /edit, /ops)
-  for a doc owned elsewhere are proxied to the lease holder (header
-  X-DT-Proxied stops a second hop; an unreachable owner degrades to a
-  local accept that anti-entropy reconciles). Lease state machine and
-  failure modes: serve/README.md.
+  Ownership: rendezvous placement of docs over the membership universe
+  (replicate/membership.py) + quorum-backed epoch leases
+  (replicate/ownership.py, replicate/quorum.py); mutations (/push,
+  /edit, /ops) for a doc owned elsewhere are proxied to the lease
+  holder (header X-DT-Proxied stops a second hop; X-DT-Lease-Epoch
+  carries the fencing token — a receiver whose per-doc epoch floor has
+  passed it answers 409 {"error": "fenced"} instead of merging; an
+  unreachable owner degrades to a local accept that anti-entropy
+  reconciles). Lease state machine, quorum safety argument and failure
+  modes: serve/README.md.
 
 Run: python -m diamond_types_tpu.tools.server --port 8008 --data-dir docs/
      [--serve-shards N] [--peers host:port,host:port,...]
+     [--join host:port]
 """
 
 from __future__ import annotations
@@ -568,11 +587,8 @@ class SyncHandler(BaseHTTPRequestHandler):
             if node is None:
                 return self._send(404, b"{}")
             if len(parts) == 2 and parts[1] == "ping":
-                return self._send(200, json.dumps(
-                    {"ok": True, "id": node.self_id,
-                     "uptime_s": round(
-                         time.monotonic() - node.started_at, 3)})
-                    .encode("utf8"))
+                return self._send(200, json.dumps(node.ping_json())
+                                  .encode("utf8"))
             if len(parts) == 2 and parts[1] == "docs":
                 # doc list + piggybacked lease claims (anti-entropy)
                 return self._send(200, json.dumps(node.docs_json())
@@ -638,12 +654,16 @@ class SyncHandler(BaseHTTPRequestHandler):
         parts = self.path.strip("/").split("/")
         if parts[:1] == ["replicate"]:
             node = self.store.replica
-            if node is None or parts[1:] != ["lease"]:
+            if node is None or len(parts) != 2 or parts[1] not in (
+                    "lease", "join", "leave"):
                 return self._send(404, b"{}")
             n = int(self.headers.get("Content-Length", 0))
             req = json.loads(self.rfile.read(n) or b"{}")
-            return self._send(200, json.dumps(
-                node.handle_lease_message(req)).encode("utf8"))
+            handler = {"lease": node.handle_lease_message,
+                       "join": node.handle_join,
+                       "leave": node.handle_leave}[parts[1]]
+            return self._send(200, json.dumps(handler(req))
+                              .encode("utf8"))
         doc_id, action = self._route()
         if doc_id is None:
             return self._send(404, b"{}")
@@ -651,6 +671,23 @@ class SyncHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(n)
         node = self.store.replica
         if node is not None and action in ("push", "edit", "ops"):
+            # Fencing check first: a proxied mutation carries the lease
+            # epoch its proxier routed by (X-DT-Lease-Epoch). If our
+            # per-doc epoch floor has passed it, the routing was based
+            # on a superseded lease — refuse with 409 rather than merge
+            # under stale ownership (the proxier falls back to a local
+            # accept and anti-entropy reconciles).
+            claimed = self.headers.get("X-DT-Lease-Epoch")
+            if claimed is not None:
+                try:
+                    claimed_epoch = int(claimed)
+                except ValueError:
+                    return self._send(400, b'{"error": "bad epoch"}')
+                if not node.check_write_fence(doc_id, claimed_epoch):
+                    return self._send(409, json.dumps(
+                        {"error": "fenced",
+                         "max_epoch": node.leases.max_epoch_of(doc_id)}
+                        ).encode("utf8"))
             # Mutations belong on the doc's lease holder: proxy them
             # there so device merges run on exactly one host. A request
             # that already hopped once is never re-proxied (two hosts
@@ -663,7 +700,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                 if self.headers.get("X-DT-Proxied") is not None:
                     node.metrics.bump("proxy", "loops_refused")
                 else:
-                    relay = node.proxy(target, self.path, body)
+                    relay = node.proxy(target, self.path, body,
+                                       doc_id=doc_id)
                     if relay is not None:
                         status, resp = relay
                         return self._send(status, resp)
@@ -908,13 +946,20 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
     handler = type("Handler", (SyncHandler,), {"store": store})
     httpd = _Server(("127.0.0.1", port), handler)
     httpd.store = store
-    if peers:
+    if peers is not None:
         from ..replicate import attach_replication
+        opts = dict(replicate_opts or {})
+        join_addr = opts.pop("join", None)
         self_id = f"127.0.0.1:{httpd.server_address[1]}"
+        if data_dir is not None and "journal_prefix" not in opts:
+            # lease epochs / promises / incarnation survive a crash
+            opts["journal_prefix"] = os.path.join(data_dir, "_replica")
         node = attach_replication(httpd, self_id,
                                   [p for p in peers if p != self_id],
-                                  **(replicate_opts or {}))
+                                  **opts)
         node.start()
+        if join_addr:
+            node.join_mesh(join_addr)
     store.start_flusher()
     return httpd
 
@@ -996,12 +1041,17 @@ def main() -> None:
                    "proxying and anti-entropy")
     p.add_argument("--lease-ttl", type=float, default=2.0,
                    help="doc-ownership lease TTL in seconds")
+    p.add_argument("--join", default=None,
+                   help="host:port of an existing mesh member to "
+                   "announce ourselves to at startup (dynamic "
+                   "membership; the mesh is learned from its reply)")
     args = p.parse_args()
     peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
-        if args.peers else None
+        if args.peers else ([] if args.join else None)
     httpd = serve(args.port, args.data_dir,
                   serve_shards=args.serve_shards, peers=peers,
-                  replicate_opts={"lease_ttl_s": args.lease_ttl})
+                  replicate_opts={"lease_ttl_s": args.lease_ttl,
+                                  "join": args.join})
     print(f"serving on http://127.0.0.1:{args.port}"
           + (f" (mesh: {','.join(peers)})" if peers else ""))
     httpd.serve_forever()

@@ -11,11 +11,14 @@ import urllib.request
 
 import pytest
 
-from diamond_types_tpu.replicate import (Backoff, FaultDrop,
-                                         FaultInjector,
+from diamond_types_tpu.replicate import (Backoff, CircuitOpen,
+                                         FaultDrop, FaultInjector,
+                                         PeerTable, ReplicaJournal,
                                          attach_replication,
                                          call_with_retries, owner_of)
+from diamond_types_tpu.replicate.metrics import ReplicationMetrics
 from diamond_types_tpu.replicate.ownership import (ACTIVE, GRANTED,
+                                                   RELEASED,
                                                    LeaseManager)
 
 pytestmark = pytest.mark.replicate
@@ -89,6 +92,39 @@ def test_backoff_deterministic_and_bounded():
     assert 1.0 <= Backoff(base_s=0.1, cap_s=2.0).delay(5000) <= 2.0
 
 
+def test_backoff_delay_jitter_bounds():
+    """Satellite: the jitter window is exactly [0.5, 1.0) of the capped
+    nominal delay, per attempt."""
+    b = Backoff(base_s=0.1, cap_s=2.0, seed=9, key="jit")
+    for attempt in range(12):
+        nominal = min(0.1 * (2 ** attempt), 2.0)
+        d = b.delay(attempt)
+        assert nominal * 0.5 <= d < nominal, (attempt, d, nominal)
+    # negative attempts clamp to the base delay's window
+    d = Backoff(base_s=0.2, cap_s=2.0, seed=1).delay(-5)
+    assert 0.1 <= d < 0.2
+
+
+def test_circuit_open_retry_at_monotonic():
+    """Satellite: consecutive failures re-open the circuit with
+    strictly growing retry_at deadlines (exponential backoff), and the
+    refusal carries the live deadline."""
+    t = PeerTable("self:0", ["127.0.0.1:9"], fail_threshold=3,
+                  backoff_base_s=0.05, backoff_cap_s=60.0, seed=4)
+    st = t.peers["127.0.0.1:9"]
+    opens = []
+    for _ in range(9):
+        t._record_failure(st)
+        if st.open_until:
+            opens.append(st.open_until)
+    assert len(opens) == 7          # opens at the 3rd failure
+    assert all(b2 > a for a, b2 in zip(opens, opens[1:]))
+    with pytest.raises(CircuitOpen) as ei:
+        t.call("127.0.0.1:9", "/replicate/ping")
+    assert ei.value.peer_id == "127.0.0.1:9"
+    assert ei.value.retry_at == st.open_until
+
+
 def test_call_with_retries_transient_vs_client_error():
     calls = []
 
@@ -146,6 +182,52 @@ def test_fault_injector_deterministic_and_partition():
     assert inj.snapshot()["partition_blocks"] == 2
 
 
+def test_fault_injector_oneway_partition_latency_skew():
+    """Satellite: asymmetric (one-way) partitions, per-link latency
+    with jitter, and clock-skew bookkeeping — all in the snapshot."""
+    inj = FaultInjector(seed=5)
+    inj.partition("a", "b", oneway=True)
+    with pytest.raises(FaultDrop):
+        inj.before_call("a", "b")       # forward direction cut
+    inj.before_call("b", "a")           # reverse still flows
+    assert inj.partitioned("a", "b") and not inj.partitioned("b", "a")
+    snap = inj.snapshot()
+    assert snap["oneway_partitions"] == [["a", "b"]]
+    assert snap["partitions"] == [["a", "b"]]
+    inj.heal("a", "b")                  # heal clears both directions
+    inj.before_call("a", "b")
+    assert inj.snapshot()["oneway_partitions"] == []
+    # per-link latency is directed and deterministic
+    t0 = __import__("time").monotonic()
+    inj.set_link_latency("a", "c", 0.01, jitter_s=0.005)
+    inj.before_call("a", "c")
+    assert __import__("time").monotonic() - t0 >= 0.01
+    inj.before_call("c", "a")           # reverse direction: no sleep
+    snap = inj.snapshot()
+    assert snap["link_delays"] == 1
+    assert snap["link_latency"] == {
+        "a->c": {"latency_s": 0.01, "jitter_s": 0.005}}
+    inj.set_link_latency("a", "c", 0.0)     # zero clears
+    assert inj.snapshot()["link_latency"] == {}
+    # clock skew is bookkeeping for expiry reasoning, not scheduling
+    inj.set_clock_skew("b", 0.75)
+    assert inj.now("b") > inj.now("a")
+    assert inj.snapshot()["clock_skew"] == {"b": 0.75}
+    # identical seeds replay identically with a jittered link enabled
+    def schedule(j):
+        j.set_link_latency("x", "y", 0.0001, jitter_s=0.0001)
+        out = []
+        for _ in range(30):
+            try:
+                out.append(j.before_call("x", "y"))
+            except FaultDrop:
+                out.append("drop")
+        return out
+    s1 = schedule(FaultInjector(seed=8, drop_rate=0.3, dup_rate=0.2))
+    s2 = schedule(FaultInjector(seed=8, drop_rate=0.3, dup_rate=0.2))
+    assert s1 == s2 and "drop" in s1
+
+
 # ---- unit: ownership -----------------------------------------------------
 
 def test_owner_rendezvous_process_independent():
@@ -192,12 +274,149 @@ def test_lease_state_machine_and_takeover():
     assert b.ensure_local("e", True)
 
 
+def test_observe_remote_equal_epoch_tie_break():
+    """Satellite (bugfix): two differing holders at one epoch resolve
+    deterministically and symmetrically — smaller id wins regardless of
+    arrival order — and each arbitration is counted."""
+    m = ReplicationMetrics()
+    c = LeaseManager("hostC", ttl_s=60.0, metrics=m)
+    c.observe_remote("d", "hostB", 4, ACTIVE, ttl_s=60.0)
+    c.observe_remote("d", "hostA", 4, ACTIVE, ttl_s=60.0)
+    assert c.get("d").holder == "hostA"
+    assert m.get("leases", "tie_breaks") == 1
+    c2 = LeaseManager("hostC", ttl_s=60.0)
+    c2.observe_remote("d", "hostA", 4, ACTIVE, ttl_s=60.0)
+    c2.observe_remote("d", "hostB", 4, ACTIVE, ttl_s=60.0)
+    assert c2.get("d").holder == "hostA"     # opposite order, same pick
+    # a peer's echo of OUR lease must never shorten our TTL
+    a = LeaseManager("hostA", ttl_s=60.0)
+    assert a.ensure_local("x", True)
+    exp = a.get("x").expires_at
+    a.observe_remote("x", "hostA", 1, ACTIVE, ttl_s=0.0)
+    assert a.get("x").expires_at == exp
+
+
+def test_promise_protocol_exclusive_and_fencing():
+    """A voter promises (doc, epoch) to at most one holder ever, and
+    every promise raises the fencing floor."""
+    m = ReplicationMetrics()
+    v = LeaseManager("voter", ttl_s=60.0, metrics=m)
+    ok, why = v.promise("d", 3, "hostA")
+    assert ok and why == "promised"
+    ok, _ = v.promise("d", 3, "hostA")       # same holder: idempotent
+    assert ok
+    ok, why = v.promise("d", 3, "hostB")     # exclusivity
+    assert not ok and why == "promise_conflict"
+    assert m.get("quorum", "promise_conflicts") == 1
+    ok, why = v.promise("d", 2, "hostB")     # floor is 3 now
+    assert not ok and why == "stale_epoch"
+    ok, why = v.promise("d", 4, "hostB")     # higher epoch: fresh slot
+    assert ok
+    assert v.max_epoch_of("d") == 4
+    # a live unexpired lease blocks a same-epoch proposer
+    v.observe_remote("e", "hostA", 5, ACTIVE, ttl_s=60.0)
+    ok, why = v.promise("e", 5, "hostB")
+    assert not ok and why == "live_lease"
+    # fencing floor revokes a superseded self-held ACTIVE lease
+    h = LeaseManager("hostA", ttl_s=60.0, metrics=ReplicationMetrics())
+    assert h.ensure_local("f", True) and h.get("f").epoch == 1
+    ok, _ = h.promise("f", 9, "hostB")       # we vote for a successor
+    assert ok and h.max_epoch_of("f") == 9
+    assert not h.ensure_local("f", True)     # revoked, not renewed
+    assert h.metrics.get("fencing", "stale_lease_revoked") == 1
+    assert h.get("f") is None
+
+
+def test_replica_journal_persist_restore(tmp_path):
+    """Crash-restart durability: floors, promises and held leases
+    survive an UNCLOSED journal (WAL replay), a closed one (compacted
+    snapshot), and feed LeaseManager.restore so a restarted node never
+    re-issues a stale epoch."""
+    prefix = str(tmp_path / "rj")
+    j = ReplicaJournal(prefix)
+    assert not j.has_prior_state()
+    j.note_incarnation(3)
+    j.note_epoch("d", 7)
+    j.note_epoch("d", 5)             # below the floor: deduped
+    j.note_promise("d", 7, "hostA")
+    j.note_lease("d", "me", 7, "active")
+    j.note_lease("e", "me", 2, "active")
+    j.drop_lease("e")
+    # crash: no close() — reopen replays the WAL
+    j2 = ReplicaJournal(prefix)
+    assert j2.has_prior_state()
+    assert j2.restored_incarnation() == 3
+    assert j2.restored_max_epochs() == {"d": 7}
+    assert j2.restored_promises() == {
+        "d": {"epoch": 7, "holder": "hostA"}}
+    assert j2.restored_leases() == {
+        "d": {"holder": "me", "epoch": 7, "state": "active"}}
+    j2.close()                       # graceful: compacts the snapshot
+    j3 = ReplicaJournal(prefix)
+    assert j3.restored_max_epochs() == {"d": 7}
+    # restore: held lease comes back RELEASED; the next acquisition
+    # plans PAST the restored floor (stale-epoch-reissue bugfix)
+    lm = LeaseManager("me", ttl_s=60.0)
+    lm.restore(j3)
+    assert lm.max_epoch_of("d") == 7
+    assert lm.get("d").state == RELEASED
+    assert lm.ensure_local("d", True)
+    assert lm.get("d").epoch == 8
+    # ... and the re-acquisition was journaled for the NEXT restart
+    j3.close()
+    j4 = ReplicaJournal(prefix)
+    assert j4.restored_max_epochs()["d"] == 8
+    assert j4.restored_leases()["d"]["epoch"] == 8
+    j4.close()
+
+
+def test_membership_states_and_refutation():
+    from diamond_types_tpu.replicate.membership import (ALIVE, DEAD,
+                                                        LEFT, SUSPECT,
+                                                        MembershipView)
+    v = MembershipView("a", incarnation=2)
+    v.add("b", state=ALIVE)
+    v.add("c", state=ALIVE)
+    assert v.universe() == ["a", "b", "c"]
+    assert v.voters() == ["a", "b", "c"] and v.quorum_size() == 2
+    # local health: short outage = SUSPECT, still in the universe
+    v.note_health("b", 1.0, dead_after_s=5.0)
+    assert v.state_of("b") == SUSPECT and "b" in v.universe()
+    # past the takeover delay = DEAD: out of the universe, still a
+    # voter (a minority partition cannot shrink the denominator)
+    v.note_health("b", 6.0, dead_after_s=5.0)
+    assert v.state_of("b") == DEAD
+    assert v.universe() == ["a", "c"]
+    assert v.voters() == ["a", "b", "c"] and v.quorum_size() == 2
+    v.note_health("b", None, dead_after_s=5.0)
+    assert v.state_of("b") == ALIVE
+    # gossip: higher incarnation wins, equal-incarnation hearsay loses
+    v.merge_remote({"b": {"state": DEAD, "incarnation": 0}})
+    assert v.state_of("b") == ALIVE
+    v.merge_remote({"b": {"state": DEAD, "incarnation": 9}})
+    assert v.state_of("b") == DEAD
+    # refutation: hearing ourselves SUSPECT bumps our incarnation
+    inc = v.self_incarnation
+    v.merge_remote({"a": {"state": SUSPECT, "incarnation": inc}})
+    assert v.self_incarnation == inc + 1
+    assert v.state_of("a") == ALIVE
+    # explicit leave: out of BOTH sets; spreads at equal incarnation
+    v.leave("c")
+    assert v.state_of("c") == LEFT
+    assert v.voters() == ["a", "b"] and v.quorum_size() == 2
+    v2 = MembershipView("b")
+    v2.add("c", state=ALIVE)
+    v2.merge_remote(v.gossip_payload())
+    assert v2.state_of("c") == LEFT
+
+
 # ---- integration: two-server smoke (tier-1 gate) -------------------------
 
 def test_two_server_smoke(tmp_path):
     """Two wired servers: ownership proxy routes mutations, anti-entropy
-    converges the pair, /metrics exposes replication counters + the
-    serve schema v2 fields on both servers."""
+    converges the pair, /metrics exposes replication counters (schema
+    v2: quorum/fencing/membership) + the serve schema v3 fields on both
+    servers."""
     from diamond_types_tpu.tools.server import SyncClient
     httpds, nodes, addrs = _mesh(2, tmp_path)
     try:
@@ -220,12 +439,18 @@ def test_two_server_smoke(tmp_path):
                 assert mergers == [holder]
         for a in addrs:
             m = _metrics(a)
-            assert m["replication"]["version"] == 1
+            assert m["replication"]["version"] == 2
             assert m["replication"]["leases"]["held"] >= 0
             assert m["replication"]["antientropy"]["rounds"] >= 1
-            assert m["serve"]["version"] == 2
+            assert "promise_conflicts" in m["replication"]["quorum"]
+            assert "rejected_writes" in m["replication"]["fencing"]
+            assert m["replication"]["quorum_view"]["quorum"] == 2
+            assert not m["replication"]["quorum_view"]["rejoining"]
+            assert m["replication"]["membership_view"]["view_version"] >= 1
+            assert m["serve"]["version"] == 3
             assert m["serve"]["uptime_s"] >= 0
             assert "denied" in m["serve"]["totals"]
+            assert "fenced" in m["serve"]["totals"]
         # ping endpoint serves health probes
         with urllib.request.urlopen(
                 f"http://{addrs[0]}/replicate/ping", timeout=5) as r:
@@ -317,6 +542,40 @@ def test_circuit_breaker_opens_and_recovers():
         assert n0.table.state(addrs[1])["consecutive_failures"] == 0
         m = n0.metrics_json()["probes"]
         assert m["circuit_opens"] == 1 and m["circuit_closes"] == 1
+    finally:
+        _teardown(httpds)
+
+
+def test_peer_down_duration_across_probe_recovery():
+    """Satellite: down_duration is None while healthy, grows while the
+    circuit stays open, and returns to None once the probe loop
+    recovers the peer."""
+    import time
+    httpds, nodes, addrs = _mesh(2, serve_shards=0)
+    try:
+        t = nodes[0].table
+        peer = addrs[1]
+        assert t.down_duration(peer) is None       # never failed
+        assert t.down_duration(t.self_id) is None  # self: always None
+        assert t.down_duration("unknown:1") == float("inf")
+        t.probe_once()
+        assert t.down_duration(peer) is None       # healthy probe
+        t.faults = FaultInjector(seed=2, drop_rate=1.0)
+        for _ in range(t.fail_threshold):
+            t.probe_once()
+        d1 = t.down_duration(peer)
+        assert d1 is not None and d1 >= 0.0
+        time.sleep(0.02)
+        assert t.down_duration(peer) > d1          # grows while down
+        # pinned `now` makes the duration arithmetic exact
+        st = t.peers[peer]
+        assert t.down_duration(peer, now=st.down_since + 1.5) == 1.5
+        t.faults = None
+        deadline = time.monotonic() + 10
+        while t.down_duration(peer) is not None:   # recovery clears it
+            t.probe_once()
+            assert time.monotonic() < deadline
+        assert t.is_healthy(peer)
     finally:
         _teardown(httpds)
 
