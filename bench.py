@@ -1356,12 +1356,19 @@ def _main() -> None:
         sv = bench_serve_sched()
         full["serve_sched"] = sv
         m = sv["metrics"]
+        dp = sv.get("devprof") or {}
         extra["serve_sched"] = {
             "ops_per_sec": sv["ops_per_sec"],
             "parity": sv["parity_ok"],
             "batch_occupancy": m["batch_occupancy"],
             "queue_bound_violations": m["queue_bound_violations"],
             "host_fallback_ratio": m["host_fallback_ratio"],
+            # obs/devprof: where flush wall time actually goes
+            "flush_p99_s": (m.get("latencies", {}).get("flush", {})
+                            .get("p99")),
+            "device_fraction": dp.get("device_fraction"),
+            "jit_cache": dp.get("jit_cache"),
+            "transfer_bytes": dp.get("transfer_bytes"),
         }
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]

@@ -1,0 +1,62 @@
+"""Unified observability for the serve scheduler and replication mesh.
+
+One `Observability` bundle per server process ties together:
+
+  trace.py     sampled spans with X-DT-Trace cross-host propagation
+  hist.py      log-bucketed latency histograms (p50/p90/p99)
+  recorder.py  flight recorder — bounded ring of structured events
+  prom.py      Prometheus text exposition of the /metrics JSON
+  devprof.py   wall-vs-device flush timing, jit-cache hits, transfers
+
+The bundle is attached as `DocStore.obs` by tools/server.serve() and
+propagated from there: MergeScheduler.attach_obs() wires the tracer
+and recorder into the admit→flush path, attach_replication() hands it
+to ReplicaNode for lease/fencing/circuit events and proxy tracing.
+Everything degrades to a no-op when the bundle is absent or disabled —
+hot paths pay one branch, zero allocations.
+"""
+
+from __future__ import annotations
+
+from .devprof import PROFILER, DeviceProfiler, note_jit_lookup, note_transfer
+from .hist import BOUNDS, Histogram, HistogramSet
+from .prom import CONTENT_TYPE, render_metrics
+from .recorder import FlightRecorder
+from .trace import (NOOP_SPAN, TRACE_HEADER, Span, SpanContext, Tracer,
+                    format_context, parse_header)
+
+__all__ = [
+    "Observability", "Tracer", "Span", "SpanContext", "NOOP_SPAN",
+    "TRACE_HEADER", "format_context", "parse_header",
+    "Histogram", "HistogramSet", "BOUNDS",
+    "FlightRecorder",
+    "CONTENT_TYPE", "render_metrics",
+    "PROFILER", "DeviceProfiler", "note_jit_lookup", "note_transfer",
+]
+
+
+class Observability:
+    """Per-server bundle: tracer + flight recorder + HTTP histograms.
+
+    `sample_rate` head-samples trace roots (default 1%: cheap enough
+    to leave on in soak runs); `enabled=False` turns the tracer and
+    recorder into allocation-free no-ops while keeping the histograms
+    (they are counters, not samples — always worth having).
+    """
+
+    def __init__(self, sample_rate: float = 0.01,
+                 trace_capacity: int = 2048,
+                 recorder_capacity: int = 512,
+                 seed: int = 0, enabled: bool = True) -> None:
+        self.tracer = Tracer(sample_rate=sample_rate,
+                             capacity=trace_capacity,
+                             seed=seed, enabled=enabled)
+        self.recorder = FlightRecorder(capacity=recorder_capacity,
+                                       enabled=enabled)
+        self.hist = HistogramSet()
+
+    def snapshot(self) -> dict:
+        return {"trace": self.tracer.stats(),
+                "recorder": self.recorder.stats(),
+                "http": self.hist.snapshot(),
+                "devprof": PROFILER.snapshot()}

@@ -1,0 +1,91 @@
+"""Device-time profiling: wall vs. device seconds per flush, compile
+cache hit/miss counts, and host<->device transfer bytes.
+
+A process-wide `PROFILER` singleton (disabled by default) keeps the
+hooks in tpu/zone_session.py and serve/bank.py down to one attribute
+check when profiling is off — the jit-cache lookup path must not pay
+for observability it isn't using. serve/driver.py enables it for
+bench runs so `bench_serve_sched` can report how much of each flush
+was actual `block_until_ready` device time versus host bookkeeping,
+which is the measurement ROADMAP item (c)'s fused-flush claim needs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class DeviceProfiler:
+    def __init__(self, enabled: bool = False) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._jit: Dict[str, list] = {}
+        self._shard: Dict[int, dict] = {}
+        self.transfers = 0
+        self.transfer_bytes = 0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._jit = {}
+            self._shard = {}
+            self.transfers = 0
+            self.transfer_bytes = 0
+
+    def note_jit(self, cache: str, hit: bool) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            c = self._jit.setdefault(cache, [0, 0])
+            c[0 if hit else 1] += 1
+
+    def observe_flush(self, shard: int, wall_s: float,
+                      device_s: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            s = self._shard.setdefault(
+                int(shard), {"flushes": 0, "wall_s": 0.0, "device_s": 0.0})
+            s["flushes"] += 1
+            s["wall_s"] += wall_s
+            s["device_s"] += device_s
+
+    def note_transfer(self, nbytes: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.transfers += 1
+            self.transfer_bytes += int(nbytes)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            jit = {k: {"hits": v[0], "misses": v[1]}
+                   for k, v in sorted(self._jit.items())}
+            per_shard = {
+                str(k): {"flushes": v["flushes"],
+                         "wall_s": round(v["wall_s"], 6),
+                         "device_s": round(v["device_s"], 6)}
+                for k, v in sorted(self._shard.items())}
+            wall = sum(v["wall_s"] for v in self._shard.values())
+            dev = sum(v["device_s"] for v in self._shard.values())
+            return {"enabled": self.enabled,
+                    "jit_cache": jit,
+                    "flush_wall_s": round(wall, 6),
+                    "device_sync_s": round(dev, 6),
+                    "device_fraction": round(dev / wall, 4) if wall else 0.0,
+                    "transfers": self.transfers,
+                    "transfer_bytes": self.transfer_bytes,
+                    "per_shard": per_shard}
+
+
+PROFILER = DeviceProfiler(enabled=False)
+
+
+def note_jit_lookup(cache: str, hit: bool) -> None:
+    if PROFILER.enabled:
+        PROFILER.note_jit(cache, hit)
+
+
+def note_transfer(nbytes: int) -> None:
+    if PROFILER.enabled:
+        PROFILER.note_transfer(nbytes)

@@ -1,0 +1,187 @@
+"""Prometheus text exposition rendered from the /metrics JSON document.
+
+`render_metrics(doc)` takes the exact dict `GET /metrics` already
+serves ({"serve": ..., "replication": ..., "obs": ...}) and flattens
+it to the text format (version 0.0.4) as `dt_*` metrics. Rendering
+from the JSON snapshot — not from live objects — guarantees the two
+formats can never disagree and keeps this module free of locks.
+
+Naming scheme:
+  dt_serve_<counter>_total            scheduler totals
+  dt_serve_flush_reason_total{reason}
+  dt_serve_shard_*{shard}             per-shard gauges/counters
+  dt_repl_<group>_<key>_total         replication counters
+  dt_<name>_latency_seconds           histograms (flush, handoff,
+                                      quorum_round, probe,
+                                      antientropy_round)
+  dt_http_request_seconds{endpoint,method}
+  dt_trace_* / dt_recorder_* / dt_devprof_*
+
+Each metric name is declared exactly once (# TYPE line) no matter how
+many labeled samples it carries; label values are escaped per the
+exposition spec (backslash, double-quote, newline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(round(v, 9))
+    return str(v)
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Builder:
+    """Accumulates samples grouped by metric family so every name gets
+    exactly one # TYPE declaration."""
+
+    def __init__(self) -> None:
+        self._order: List[str] = []
+        self._fams: Dict[str, dict] = {}
+
+    def add(self, name: str, mtype: str, value,
+            labels: Optional[dict] = None,
+            suffix: str = "") -> None:
+        fam = self._fams.get(name)
+        if fam is None:
+            fam = {"type": mtype, "lines": []}
+            self._fams[name] = fam
+            self._order.append(name)
+        fam["lines"].append(
+            f"{name}{suffix}{_fmt_labels(labels)} {_fmt_value(value)}")
+
+    def histogram(self, name: str, snap: dict,
+                  labels: Optional[dict] = None) -> None:
+        """Render one obs.hist.Histogram.snapshot() (with `buckets`)
+        as a Prometheus histogram family."""
+        for le, cum in snap.get("buckets", []):
+            bl = dict(labels or {})
+            bl["le"] = le if isinstance(le, str) else repr(float(le))
+            self.add(name, "histogram", cum, labels=bl, suffix="_bucket")
+        self.add(name, "histogram", snap.get("sum", 0.0),
+                 labels=labels, suffix="_sum")
+        self.add(name, "histogram", snap.get("count", 0),
+                 labels=labels, suffix="_count")
+
+    def render(self) -> str:
+        out: List[str] = []
+        for name in self._order:
+            fam = self._fams[name]
+            out.append(f"# TYPE {name} {fam['type']}")
+            out.extend(fam["lines"])
+        return "\n".join(out) + "\n"
+
+
+def _render_serve(b: _Builder, serve: dict) -> None:
+    for key, mtype in (("uptime_s", "gauge"),
+                       ("batch_occupancy", "gauge"),
+                       ("host_fallback_ratio", "gauge"),
+                       ("max_depth_seen", "gauge")):
+        if key in serve:
+            b.add(f"dt_serve_{key}", mtype, serve[key])
+    if "queue_bound_violations" in serve:
+        b.add("dt_serve_queue_bound_violations_total", "counter",
+              serve["queue_bound_violations"])
+    for k, v in sorted((serve.get("totals") or {}).items()):
+        b.add(f"dt_serve_{k}_total", "counter", v)
+    for reason, n in sorted((serve.get("flush_reasons") or {}).items()):
+        b.add("dt_serve_flush_reason_total", "counter", n,
+              labels={"reason": reason})
+    for i, row in enumerate(serve.get("per_shard") or []):
+        lb = {"shard": str(row.get("shard", i))}
+        if "queue_depth" in row:
+            b.add("dt_serve_shard_queue_depth", "gauge",
+                  row["queue_depth"], labels=lb)
+        if "footprint_slots" in row:
+            b.add("dt_serve_shard_footprint_slots", "gauge",
+                  row["footprint_slots"], labels=lb)
+        if "flush_wall_s" in row:
+            b.add("dt_serve_shard_flush_wall_seconds_total", "counter",
+                  row["flush_wall_s"], labels=lb)
+        if "device_sync_s" in row:
+            b.add("dt_serve_shard_device_sync_seconds_total", "counter",
+                  row["device_sync_s"], labels=lb)
+    for name, snap in sorted((serve.get("latencies") or {}).items()):
+        b.histogram(f"dt_{name}_latency_seconds", snap)
+
+
+def _render_replication(b: _Builder, repl: dict) -> None:
+    for group, vals in sorted(repl.items()):
+        if group in ("version", "self", "latencies") or \
+                not isinstance(vals, dict):
+            continue
+        if group in ("per_peer", "membership_view", "quorum_view",
+                     "faults"):
+            continue
+        for k, v in sorted(vals.items()):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if isinstance(v, float):
+                b.add(f"dt_repl_{group}_{k}", "gauge", v)
+            else:
+                b.add(f"dt_repl_{group}_{k}_total", "counter", v)
+    for name, snap in sorted((repl.get("latencies") or {}).items()):
+        b.histogram(f"dt_{name}_latency_seconds", snap)
+
+
+def _render_obs(b: _Builder, obs: dict) -> None:
+    for name, series in sorted((obs.get("http") or {}).items()):
+        for entry in series:
+            b.histogram(f"dt_{name}_seconds", entry,
+                        labels=entry.get("labels") or {})
+    tr = obs.get("trace") or {}
+    for k in ("started", "sampled_out", "finished"):
+        if k in tr:
+            b.add(f"dt_trace_spans_{k}_total", "counter", tr[k])
+    rec = obs.get("recorder") or {}
+    for k in ("recorded", "dropped"):
+        if k in rec:
+            b.add(f"dt_recorder_events_{k}_total", "counter", rec[k])
+    dp = obs.get("devprof") or {}
+    for cache, hm in sorted((dp.get("jit_cache") or {}).items()):
+        lb = {"cache": cache}
+        b.add("dt_devprof_jit_hits_total", "counter",
+              hm.get("hits", 0), labels=lb)
+        b.add("dt_devprof_jit_misses_total", "counter",
+              hm.get("misses", 0), labels=lb)
+    if dp:
+        b.add("dt_devprof_flush_wall_seconds_total", "counter",
+              dp.get("flush_wall_s", 0.0))
+        b.add("dt_devprof_device_sync_seconds_total", "counter",
+              dp.get("device_sync_s", 0.0))
+        b.add("dt_devprof_transfer_bytes_total", "counter",
+              dp.get("transfer_bytes", 0))
+
+
+def render_metrics(doc: dict) -> str:
+    """Flatten the /metrics JSON document to Prometheus text format."""
+    b = _Builder()
+    serve = doc.get("serve")
+    if isinstance(serve, dict):
+        _render_serve(b, serve)
+    repl = doc.get("replication")
+    if isinstance(repl, dict):
+        _render_replication(b, repl)
+    obs = doc.get("obs")
+    if isinstance(obs, dict):
+        _render_obs(b, obs)
+    return b.render()

@@ -53,6 +53,7 @@ class AntiEntropy:
         Never raises: per-doc failures are counted and the round moves
         on — a flaky link degrades convergence speed, not the loop."""
         node = self.node
+        t0 = time.monotonic()
         peers = [peer_id] if peer_id is not None \
             else [p for p in node.table.peer_ids()
                   if node.table.is_healthy(p)]
@@ -64,6 +65,8 @@ class AntiEntropy:
             report["pushed"] += rep["pushed"]
             report["errors"] += rep["errors"]
         node.metrics.bump("antientropy", "rounds")
+        node.metrics.observe_latency("antientropy_round",
+                                     time.monotonic() - t0)
         return report
 
     def _round_with(self, peer_id: str) -> dict:
@@ -119,9 +122,21 @@ class AntiEntropy:
                                           from_version=common)
         out = {"pulled": 0, "pushed": 0}
         if remainder:
+            from ..obs.trace import NOOP_SPAN, TRACE_HEADER
+            obs = getattr(node, "obs", None)
+            span = NOOP_SPAN
+            hdrs = None
+            if obs is not None:
+                span = obs.tracer.start(
+                    "repl.ae_pull", attrs={"peer": peer_id,
+                                           "doc": doc_id})
+                if span.sampled:
+                    hdrs = {TRACE_HEADER: span.header()}
             _st, patch = node.table.call(
                 peer_id, f"/doc/{doc_id}/pull",
-                data=json.dumps(local_summary).encode("utf8"))
+                data=json.dumps(local_summary).encode("utf8"),
+                headers=hdrs)
+            span.end(bytes=len(patch))
             with store.lock:
                 pre_len = len(ol)
                 decode_into(ol, patch)

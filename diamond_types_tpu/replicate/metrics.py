@@ -5,10 +5,20 @@ small lock, recording never touches the network or the device. The
 snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
-Schema (snapshot()) — v2 adds the quorum / fencing / membership groups
-and `leases.tie_breaks` (the partition-safety PR):
+Changelog:
+  v3  latency observations moved onto obs.hist log-bucketed
+      histograms. `handoffs.latency_s_total/latency_s_max` are now
+      DERIVED from the handoff histogram (kept so schema-v2 scrapers
+      keep working); the new `latencies` group carries full histogram
+      snapshots (count/sum/max/p50/p90/p99/buckets) for `handoff`,
+      `quorum_round`, `probe`, and `antientropy_round`.
+  v2  quorum / fencing / membership groups, `leases.tie_breaks`,
+      `proxy.fenced_relays`, membership_view + quorum_view objects
+      (the partition-safety PR).
 
-  {"version": 2, "self": "host:port",
+Schema (snapshot()):
+
+  {"version": 3, "self": "host:port",
    "leases": {"held", "acquires", "renewals", "takeovers", "releases",
               "tie_breaks",        # equal-epoch conflicts arbitrated
               "churn"},            # churn = acquires+takeovers+releases
@@ -29,6 +39,8 @@ and `leases.tie_breaks` (the partition-safety PR):
                "rejoin_denials"},       # merges denied while rejoining
    "membership": {"joins", "leaves", "suspicions", "refutations",
                   "deaths"},
+   "latencies": {"handoff": hist, "quorum_round": hist,
+                 "probe": hist, "antientropy_round": hist},
    "per_peer": {peer_id: {"consecutive_failures", "circuit_open",
                           "backoff_s", "last_ok_age_s"}},
    "membership_view": {"view_version", "members": {...}} | null,
@@ -40,6 +52,11 @@ from __future__ import annotations
 
 import threading
 from typing import Dict
+
+from ..obs.hist import Histogram
+
+_LATENCY_NAMES = ("handoff", "quorum_round", "probe",
+                  "antientropy_round")
 
 _GROUPS = {
     "leases": ("acquires", "renewals", "takeovers", "releases",
@@ -63,17 +80,16 @@ _GROUPS = {
 
 
 class ReplicationMetrics:
-    # v1 -> v2: quorum / fencing / membership groups, leases.tie_breaks,
-    # proxy.fenced_relays, membership_view + quorum_view objects
-    SCHEMA_VERSION = 2
+    # v2 -> v3: latency histograms (see module docstring changelog)
+    SCHEMA_VERSION = 3
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id
         self._lock = threading.Lock()
         self._c: Dict[str, Dict[str, int]] = {
             g: {k: 0 for k in keys} for g, keys in _GROUPS.items()}
-        self._handoff_latency_total = 0.0
-        self._handoff_latency_max = 0.0
+        self.hist: Dict[str, Histogram] = {
+            n: Histogram() for n in _LATENCY_NAMES}
 
     def bump(self, group: str, key: str, n: int = 1) -> None:
         with self._lock:
@@ -83,25 +99,32 @@ class ReplicationMetrics:
         with self._lock:
             return self._c[group][key]
 
+    def observe_latency(self, name: str, seconds: float) -> None:
+        h = self.hist.get(name)
+        if h is None:
+            with self._lock:
+                h = self.hist.setdefault(name, Histogram())
+        h.record(seconds)
+
     def observe_handoff_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._handoff_latency_total += seconds
-            if seconds > self._handoff_latency_max:
-                self._handoff_latency_max = seconds
+        self.observe_latency("handoff", seconds)
 
     def snapshot(self, leases_held: int = 0, per_peer: dict = None,
                  faults: dict = None, membership_view: dict = None,
                  quorum_view: dict = None) -> dict:
+        # histograms carry their own locks; snapshot before taking ours
+        latencies = {n: h.snapshot() for n, h in
+                     sorted(self.hist.items())}
+        handoff = latencies["handoff"]
         with self._lock:
             leases = dict(self._c["leases"])
             leases["held"] = leases_held
             leases["churn"] = (leases["acquires"] + leases["takeovers"]
                                + leases["releases"])
             handoffs = dict(self._c["handoffs"])
-            handoffs["latency_s_total"] = round(
-                self._handoff_latency_total, 6)
-            handoffs["latency_s_max"] = round(
-                self._handoff_latency_max, 6)
+            # v2-compat keys, now derived from the histogram
+            handoffs["latency_s_total"] = handoff["sum"]
+            handoffs["latency_s_max"] = handoff["max"]
             return {
                 "version": self.SCHEMA_VERSION,
                 "self": self.self_id,
@@ -114,6 +137,7 @@ class ReplicationMetrics:
                 "quorum": dict(self._c["quorum"]),
                 "fencing": dict(self._c["fencing"]),
                 "membership": dict(self._c["membership"]),
+                "latencies": latencies,
                 "per_peer": per_peer or {},
                 "membership_view": membership_view,
                 "quorum_view": quorum_view,

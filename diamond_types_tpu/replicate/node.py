@@ -58,6 +58,7 @@ from typing import List, Optional, Set, Tuple
 
 from ..causalgraph.summary import intersect_with_summary
 from ..encoding.encode import ENCODE_PATCH, encode_oplog
+from ..obs.trace import NOOP_SPAN, TRACE_HEADER, format_context
 from .antientropy import AntiEntropy
 from .faults import FaultInjector
 from .membership import ALIVE, LEFT, MembershipView
@@ -79,7 +80,8 @@ class ReplicaNode:
                  backoff_cap_s: float = 5.0,
                  takeover_after_s: Optional[float] = None,
                  faults: Optional[FaultInjector] = None,
-                 journal_prefix: Optional[str] = None) -> None:
+                 journal_prefix: Optional[str] = None,
+                 obs=None) -> None:
         self.store = store
         self.self_id = self_id
         self.started_at = time.monotonic()
@@ -99,6 +101,13 @@ class ReplicaNode:
                                faults=faults, metrics=self.metrics)
         self.leases = LeaseManager(self_id, ttl_s=lease_ttl_s,
                                    metrics=self.metrics)
+        # obs.Observability bundle (usually the DocStore's, via
+        # attach_replication): spans on proxy/handoff/quorum, flight
+        # recorder for lease/fencing/circuit events
+        self.obs = obs
+        if obs is not None:
+            self.table.recorder = obs.recorder
+            self.leases.recorder = obs.recorder
         # ---- crash-restart restore ----
         self.journal: Optional[ReplicaJournal] = None
         self.rejoining = False
@@ -195,36 +204,54 @@ class ReplicaNode:
     # ---- proxy -----------------------------------------------------------
 
     def proxy(self, target: str, path: str, body: bytes,
-              doc_id: Optional[str] = None) -> Optional[Tuple[int, bytes]]:
+              doc_id: Optional[str] = None,
+              trace=None) -> Optional[Tuple[int, bytes]]:
         """Forward a mutation to its owner, stamping the lease epoch we
         routed by (the fencing token). Returns (status, body) to relay,
         or None when the caller should accept locally instead: target
         unreachable, or target fenced the epoch (our routing info was
-        stale — anti-entropy reconciles once the new lease propagates)."""
+        stale — anti-entropy reconciles once the new lease propagates).
+        `trace` (obs SpanContext of the local HTTP span) rides the
+        X-DT-Trace header so the owner's handling joins the trace."""
         headers = {"X-DT-Proxied": "1"}
         if doc_id is not None:
             lease = self.leases.get(doc_id)
             if lease is not None and lease.holder == target:
                 headers["X-DT-Lease-Epoch"] = str(lease.epoch)
+        span = NOOP_SPAN
+        if self.obs is not None:
+            span = self.obs.tracer.start(
+                "repl.proxy", parent=trace,
+                attrs={"target": target, "doc": doc_id})
+        ctx = span.context() if span.sampled else trace
+        if ctx is not None:
+            headers[TRACE_HEADER] = format_context(ctx)
         try:
-            status, resp = self.table.call(target, path, data=body,
-                                           headers=headers)
-        except urllib.error.HTTPError as e:
-            # owner answered with an application error: relay verbatim
-            status, resp = e.code, e.read()
-        except OSError:
-            self.metrics.bump("proxy", "fallback_local")
-            return None
-        if status == 409:
             try:
-                fenced = json.loads(resp or b"{}").get("error") == "fenced"
-            except ValueError:
-                fenced = False
-            if fenced:
-                self.metrics.bump("proxy", "fenced_relays")
+                status, resp = self.table.call(target, path, data=body,
+                                               headers=headers)
+            except urllib.error.HTTPError as e:
+                # owner answered with an application error: relay it
+                status, resp = e.code, e.read()
+            except OSError:
+                self.metrics.bump("proxy", "fallback_local")
+                span.annotate(outcome="fallback_local")
                 return None
-        self.metrics.bump("proxy", "proxied")
-        return status, resp
+            if status == 409:
+                try:
+                    fenced = json.loads(resp or b"{}").get("error") \
+                        == "fenced"
+                except ValueError:
+                    fenced = False
+                if fenced:
+                    self.metrics.bump("proxy", "fenced_relays")
+                    span.annotate(outcome="fenced")
+                    return None
+            self.metrics.bump("proxy", "proxied")
+            span.annotate(outcome="proxied", status=status)
+            return status, resp
+        finally:
+            span.end()
 
     def check_write_fence(self, doc_id: str,
                           claimed_epoch: int) -> bool:
@@ -232,9 +259,14 @@ class ReplicaNode:
         claiming `claimed_epoch` be applied to `doc_id`? False when the
         fencing floor has passed the claim — the proxier routed by a
         lease that has been superseded."""
-        if claimed_epoch >= self.leases.max_epoch_of(doc_id):
+        floor = self.leases.max_epoch_of(doc_id)
+        if claimed_epoch >= floor:
             return True
         self.metrics.bump("fencing", "rejected_writes")
+        if self.obs is not None:
+            self.obs.recorder.record("fencing_rejected", doc=doc_id,
+                                     claimed_epoch=claimed_epoch,
+                                     floor=floor)
         return False
 
     # ---- handoff (sender) ------------------------------------------------
@@ -250,53 +282,82 @@ class ReplicaNode:
         if new_epoch is None:
             return False
         self.metrics.bump("handoffs", "started")
+        span = NOOP_SPAN
+        if self.obs is not None:
+            span = self.obs.tracer.start(
+                "repl.handoff", attrs={"doc": doc_id, "to": new_owner,
+                                       "epoch": new_epoch})
+
+        def phase(name):
+            # child span per handoff stage; the grant/activate calls
+            # carry the trace header so the receiver's lease handling
+            # joins the same trace
+            if not span.sampled:
+                return NOOP_SPAN
+            return self.obs.tracer.start(name, parent=span.context())
+
+        hdrs = {TRACE_HEADER: span.header()} if span.sampled else None
         try:
             # grant: the receiver records a not-yet-active lease (its
             # TTL covers the whole handoff, so a crashed sender leaves
             # a lease that expires rather than a stuck doc)
-            resp = self.table.call_json(
-                new_owner, "/replicate/lease",
-                {"action": "grant", "doc": doc_id, "epoch": new_epoch,
-                 "ttl_s": self.leases.ttl_s * 4})
-            if not resp.get("ok"):
-                raise ValueError(f"grant refused: {resp!r}")
+            with phase("repl.handoff.grant"):
+                resp = self.table.call_json(
+                    new_owner, "/replicate/lease",
+                    {"action": "grant", "doc": doc_id,
+                     "epoch": new_epoch,
+                     "ttl_s": self.leases.ttl_s * 4},
+                    headers=hdrs)
+                if not resp.get("ok"):
+                    raise ValueError(f"grant refused: {resp!r}")
             # drain: flush our pending merge work for the doc so the
             # final patch includes every admitted op
-            self.leases.advance_handoff(doc_id, DRAINING)
-            sched = getattr(self.store, "scheduler", None)
-            if sched is not None:
-                sched.drain()
+            with phase("repl.handoff.drain"):
+                self.leases.advance_handoff(doc_id, DRAINING)
+                sched = getattr(self.store, "scheduler", None)
+                if sched is not None:
+                    sched.drain()
             # final patch transfer (from the receiver's common version)
-            self.leases.advance_handoff(doc_id, TRANSFER)
-            remote_summary = self.table.call_json(
-                new_owner, f"/doc/{doc_id}/summary")
-            ol = self.store.get(doc_id)
-            with self.store.lock:
-                common, _rem = intersect_with_summary(ol.cg,
-                                                      remote_summary)
-                patch = None
-                if sorted(common) != sorted(ol.version):
-                    patch = encode_oplog(ol, ENCODE_PATCH,
-                                         from_version=common)
-            if patch is not None:
-                self.table.call(new_owner, f"/doc/{doc_id}/push",
-                                data=patch)
+            with phase("repl.handoff.transfer"):
+                self.leases.advance_handoff(doc_id, TRANSFER)
+                remote_summary = self.table.call_json(
+                    new_owner, f"/doc/{doc_id}/summary")
+                ol = self.store.get(doc_id)
+                with self.store.lock:
+                    common, _rem = intersect_with_summary(
+                        ol.cg, remote_summary)
+                    patch = None
+                    if sorted(common) != sorted(ol.version):
+                        patch = encode_oplog(ol, ENCODE_PATCH,
+                                             from_version=common)
+                if patch is not None:
+                    self.table.call(new_owner, f"/doc/{doc_id}/push",
+                                    data=patch)
             # activate: receiver runs the quorum round for new_epoch,
             # then flips GRANTED -> ACTIVE; we release
-            resp = self.table.call_json(
-                new_owner, "/replicate/lease",
-                {"action": "activate", "doc": doc_id,
-                 "epoch": new_epoch})
-            if not resp.get("ok"):
-                raise ValueError(f"activate refused: {resp!r}")
+            with phase("repl.handoff.activate"):
+                resp = self.table.call_json(
+                    new_owner, "/replicate/lease",
+                    {"action": "activate", "doc": doc_id,
+                     "epoch": new_epoch},
+                    headers=hdrs)
+                if not resp.get("ok"):
+                    raise ValueError(f"activate refused: {resp!r}")
             self.leases.finish_handoff(doc_id, new_owner, new_epoch)
             self.metrics.bump("handoffs", "completed")
             self.metrics.observe_handoff_latency(time.monotonic() - t0)
+            span.end(outcome="completed")
             return True
         except (OSError, ValueError, KeyError,
-                urllib.error.HTTPError):
+                urllib.error.HTTPError) as e:
             self.leases.abort_handoff(doc_id)
             self.metrics.bump("handoffs", "failed")
+            if self.obs is not None:
+                self.obs.recorder.record(
+                    "handoff_failed", doc=doc_id, to=new_owner,
+                    epoch=new_epoch,
+                    error=f"{e.__class__.__name__}: {e}"[:120])
+            span.end(outcome="failed")
             return False
 
     # ---- lease wire handler (receiver) -----------------------------------
@@ -508,6 +569,8 @@ def attach_replication(httpd, self_id: str, peer_addrs: List[str],
     Split from serve() because tests bind port 0 first and only then
     know their own `host:port` identity."""
     store = httpd.store
+    if "obs" not in opts:
+        opts["obs"] = getattr(store, "obs", None)
     node = ReplicaNode(store, self_id, peer_addrs, **opts)
     store.replica = node
     if getattr(store, "scheduler", None) is not None:

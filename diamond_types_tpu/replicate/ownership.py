@@ -144,6 +144,9 @@ class LeaseManager:
         # persists floors/promises/held leases across restarts
         self.quorum: Optional[Callable[[str, int, bool], bool]] = None
         self.journal = None
+        # obs.recorder.FlightRecorder (wired by node.ReplicaNode);
+        # every lease transition is rare enough to record
+        self.recorder = None
         self.lock = threading.RLock()
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -153,6 +156,12 @@ class LeaseManager:
     def _bump_group(self, group: str, key: str, n: int = 1) -> None:
         if self.metrics is not None:
             self.metrics.bump(group, key, n)
+
+    def _event(self, kind: str, doc_id: str, epoch: int,
+               **fields) -> None:
+        r = self.recorder
+        if r is not None:
+            r.record(kind, doc=doc_id, epoch=epoch, **fields)
 
     # ---- fencing floor / journal (callers hold self.lock) ----------------
 
@@ -283,6 +292,8 @@ class LeaseManager:
                         del self.leases[doc_id]
                         self._bump_group("fencing",
                                          "stale_lease_revoked")
+                        self._event("lease_fenced", doc_id,
+                                    lease.epoch, floor=floor)
                         if self.journal is not None:
                             self.journal.drop_lease(doc_id)
                         return False
@@ -333,6 +344,8 @@ class LeaseManager:
             self._note_epoch_locked(doc_id, epoch)
             self._log_activation_locked(doc_id, epoch)
             self._bump("takeovers" if takeover else "acquires")
+            self._event("lease_acquired", doc_id, epoch,
+                        takeover=takeover)
             if self.journal is not None:
                 self.journal.note_lease(doc_id, self.self_id, epoch,
                                         ACTIVE)
@@ -359,6 +372,8 @@ class LeaseManager:
                     return False, "promised_higher"
                 if epoch == p_epoch and holder != p_holder:
                     self._bump_group("quorum", "promise_conflicts")
+                    self._event("promise_conflict", doc_id, epoch,
+                                holder=holder, promised_to=p_holder)
                     return False, "promise_conflict"
             cur = self.leases.get(doc_id)
             if cur is not None and cur.holder != holder \
@@ -397,6 +412,8 @@ class LeaseManager:
                         cur.expires_at = now + max(ttl_s, 0.0)
                         return
                     self._bump("tie_breaks")
+                    self._event("lease_tie_break", doc_id, epoch,
+                                incumbent=cur.holder, claimant=holder)
                     if cur.holder < holder:
                         return       # incumbent (smaller id) wins
                     # incoming smaller id wins: fall through, replace
@@ -421,6 +438,7 @@ class LeaseManager:
             self.leases[doc_id] = Lease(doc_id, self.self_id, epoch,
                                         GRANTED, now + max(ttl_s, 0.0))
             self._note_epoch_locked(doc_id, epoch)
+            self._event("lease_granted", doc_id, epoch)
             return True
 
     def activate_grant(self, doc_id: str, epoch: int) -> bool:
@@ -443,6 +461,7 @@ class LeaseManager:
             self._note_epoch_locked(doc_id, epoch)
             self._log_activation_locked(doc_id, epoch)
             self._bump("acquires")
+            self._event("lease_activated", doc_id, epoch)
             if self.journal is not None:
                 self.journal.note_lease(doc_id, self.self_id, epoch,
                                         ACTIVE)
@@ -460,14 +479,17 @@ class LeaseManager:
                     or lease.state != ACTIVE:
                 return None
             lease.state = GRANTING
-            return max(lease.epoch,
-                       self.max_epoch.get(doc_id, 0)) + 1
+            new_epoch = max(lease.epoch,
+                            self.max_epoch.get(doc_id, 0)) + 1
+            self._event("handoff_granting", doc_id, new_epoch)
+            return new_epoch
 
     def advance_handoff(self, doc_id: str, state: str) -> None:
         assert state in (DRAINING, TRANSFER)
         with self.lock:
             lease = self.leases[doc_id]
             lease.state = state
+            self._event(f"handoff_{state}", doc_id, lease.epoch)
 
     def finish_handoff(self, doc_id: str, new_holder: str,
                        new_epoch: int) -> None:
@@ -478,6 +500,8 @@ class LeaseManager:
                                         ACTIVE, now + self.ttl_s)
             self._note_epoch_locked(doc_id, new_epoch)
             self._bump("releases")
+            self._event("lease_released", doc_id, new_epoch,
+                        new_holder=new_holder)
             if self.journal is not None:
                 self.journal.note_lease(doc_id, new_holder, new_epoch,
                                         ACTIVE)
@@ -491,6 +515,7 @@ class LeaseManager:
                     and lease.state in _HANDOFF_STATES:
                 lease.state = ACTIVE
                 lease.expires_at = time.monotonic() + self.ttl_s
+                self._event("handoff_aborted", doc_id, lease.epoch)
 
     # ---- export ----------------------------------------------------------
 

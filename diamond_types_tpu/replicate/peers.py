@@ -111,6 +111,9 @@ class PeerTable:
         self.fail_threshold = max(int(fail_threshold), 1)
         self.faults = faults
         self.metrics = metrics
+        # obs.recorder.FlightRecorder (wired by ReplicaNode): circuit
+        # transitions are the events partition post-mortems need
+        self.recorder = None
         self._backoff_base_s = backoff_base_s
         self._backoff_cap_s = backoff_cap_s
         self._seed = seed
@@ -207,12 +210,16 @@ class PeerTable:
             st.failures = 0
             st.open_until = 0.0
             st.last_ok = time.monotonic()
-        if reopened and self.metrics is not None:
-            self.metrics.bump("probes", "circuit_closes")
+        if reopened:
+            if self.metrics is not None:
+                self.metrics.bump("probes", "circuit_closes")
+            if self.recorder is not None:
+                self.recorder.record("circuit_close", peer=st.addr)
 
     def _record_failure(self, st: _PeerState) -> None:
         with self._lock:
             st.failures += 1
+            failures = st.failures
             opened = False
             if st.failures >= self.fail_threshold:
                 now = time.monotonic()
@@ -221,8 +228,12 @@ class PeerTable:
                     st.down_since = now
                 st.open_until = now + st.backoff.delay(
                     st.failures - self.fail_threshold)
-        if opened and self.metrics is not None:
-            self.metrics.bump("probes", "circuit_opens")
+        if opened:
+            if self.metrics is not None:
+                self.metrics.bump("probes", "circuit_opens")
+            if self.recorder is not None:
+                self.recorder.record("circuit_open", peer=st.addr,
+                                     failures=failures)
 
     # ---- calls -----------------------------------------------------------
 
@@ -286,11 +297,12 @@ class PeerTable:
 
     def call_json(self, peer_id: str, path: str,
                   obj: Optional[dict] = None,
-                  timeout: Optional[float] = None) -> dict:
+                  timeout: Optional[float] = None,
+                  headers: Optional[dict] = None) -> dict:
         data = (json.dumps(obj).encode("utf8")
                 if obj is not None else None)
         _status, body = self.call(peer_id, path, data=data,
-                                  timeout=timeout)
+                                  timeout=timeout, headers=headers)
         return json.loads(body or b"{}")
 
     # ---- probe loop ------------------------------------------------------
@@ -300,6 +312,7 @@ class PeerTable:
         A 200 body is parsed and handed to the `on_ping` gossip hook
         (membership piggyback rides the probe loop for free)."""
         body = b""
+        t0 = time.monotonic()
         try:
             status, body = self.call(peer_id, "/replicate/ping",
                                      probe=True)
@@ -310,6 +323,8 @@ class PeerTable:
             ok = False
         if self.metrics is not None:
             self.metrics.bump("probes", "ok" if ok else "failed")
+            self.metrics.observe_latency("probe",
+                                         time.monotonic() - t0)
         if ok and self.on_ping is not None:
             try:
                 self.on_ping(peer_id, json.loads(body or b"{}"))

@@ -46,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import urllib.error
 from typing import Dict, Optional
 
@@ -226,14 +227,26 @@ class QuorumCoordinator:
         voter set. Our own promise is taken first (and is binding: if
         we cannot promise to ourselves, someone beat us to the epoch).
         Best-effort short-circuit once the majority is reached."""
+        from ..obs.trace import NOOP_SPAN, TRACE_HEADER
         node = self.node
         metrics = node.metrics
+        obs = getattr(node, "obs", None)
+        t0 = time.monotonic()
+        span = NOOP_SPAN
+        if obs is not None:
+            span = obs.tracer.start(
+                "repl.quorum", attrs={"doc": doc_id, "epoch": epoch,
+                                      "takeover": bool(takeover)})
+        hdrs = {TRACE_HEADER: span.header()} if span.sampled else None
         voters = node.membership.voters()
         need = len(voters) // 2 + 1
         metrics.bump("quorum", "proposals")
         ok, _reason = node.leases.promise(doc_id, epoch, node.self_id)
         if not ok:
             metrics.bump("quorum", "rounds_lost")
+            metrics.observe_latency("quorum_round",
+                                    time.monotonic() - t0)
+            span.end(won=False, reason="self_promise_refused")
             return False
         acks = 1
         for v in voters:
@@ -246,7 +259,8 @@ class QuorumCoordinator:
                     v, "/replicate/lease",
                     {"action": "propose", "doc": doc_id,
                      "epoch": epoch, "holder": node.self_id,
-                     "takeover": bool(takeover)})
+                     "takeover": bool(takeover)},
+                    headers=hdrs)
             except (OSError, KeyError, ValueError,
                     urllib.error.HTTPError):
                 continue            # unreachable voter = no ack
@@ -257,4 +271,6 @@ class QuorumCoordinator:
                 metrics.bump("quorum", "denials")
         won = acks >= need
         metrics.bump("quorum", "rounds_won" if won else "rounds_lost")
+        metrics.observe_latency("quorum_round", time.monotonic() - t0)
+        span.end(won=won, acks=acks, need=need)
         return won

@@ -342,6 +342,18 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
         "wall_s": round(time.monotonic() - t0, 3),
         "metrics": {n.self_id: n.metrics_json() for n in live_nodes},
     }
+    if not (converged and not split_brain):
+        # flight-recorder tail makes a failed soak diagnosable from the
+        # JSON report alone: last 50 events across all live recorders
+        events = []
+        for n in live_nodes:
+            obs = getattr(n, "obs", None)
+            if obs is None:
+                continue
+            for ev in obs.recorder.tail(50):
+                events.append(dict(ev, node=n.self_id))
+        events.sort(key=lambda e: e.get("t", 0.0))
+        report["events_tail"] = events[-50:]
     for j, httpd in enumerate(httpds):
         if live[j]:
             httpd.shutdown()

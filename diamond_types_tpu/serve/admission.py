@@ -47,6 +47,10 @@ class PendingMerge:
     # admitted under a lease this host no longer holds is dropped, not
     # merged (the new owner merges the same durable oplog instead).
     epoch: int = -1
+    # obs.trace.SpanContext of the sampled admit that queued this work
+    # (None when unsampled/untraced) — lets the flush span parent on
+    # the originating edit's trace
+    trace: object = None
 
 
 class Backpressure(Exception):
@@ -89,18 +93,21 @@ class AdmissionQueue:
         return sum(len(w) for w in self._where)
 
     def submit(self, shard: int, doc_id: str, n_ops: int,
-               now: float, epoch: int = -1) -> int:
+               now: float, epoch: int = -1, trace=None) -> int:
         """Queue (or coalesce) `n_ops` of pending merge work for
         `doc_id`. Returns the shape bucket it landed in. Raises
         Backpressure instead of exceeding `max_pending` docs/shard.
         Coalescing adopts the LATEST lease epoch — earlier queued ops
-        are covered by the newer admit decision."""
+        are covered by the newer admit decision — and keeps a sampled
+        trace context if any submit in the batch carried one."""
         where = self._where[shard]
         old_bucket = where.get(doc_id)
         if old_bucket is not None:
             item = self._q[shard][old_bucket].pop(doc_id)
             item.n_ops += max(int(n_ops), 0)
             item.epoch = epoch
+            if trace is not None:
+                item.trace = trace
             bucket = shape_bucket(item.n_ops)
             self._q[shard].setdefault(bucket, {})[doc_id] = item
             where[doc_id] = bucket
@@ -111,7 +118,7 @@ class AdmissionQueue:
             raise Backpressure(shard, len(where), self.flush_deadline_s)
         bucket = shape_bucket(n_ops)
         self._q[shard].setdefault(bucket, {})[doc_id] = PendingMerge(
-            doc_id, max(int(n_ops), 1), now, epoch)
+            doc_id, max(int(n_ops), 1), now, epoch, trace)
         where[doc_id] = bucket
         return bucket
 

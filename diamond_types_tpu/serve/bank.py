@@ -27,9 +27,11 @@ accelerator tunnel must never hang a request handler).
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Dict, Optional
 
+from ..obs.devprof import PROFILER
 from .metrics import ServeMetrics
 
 
@@ -71,6 +73,9 @@ class SessionBank:
         self.session_opts = dict(session_opts or {})
         self.sessions: "OrderedDict[str, object]" = OrderedDict()
         self._resyncs_seen: Dict[str, int] = {}
+        # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
+        # evictions and fallbacks are rare enough to record each one
+        self.recorder = None
 
     # ---- accounting ------------------------------------------------------
 
@@ -94,11 +99,19 @@ class SessionBank:
             self.sessions.pop(victim)
             self._resyncs_seen.pop(victim, None)
             self._bump("evictions")
+            if self.recorder is not None:
+                self.recorder.record("session_evicted",
+                                     shard=self.shard_id, doc=victim,
+                                     why="capacity")
 
     def evict(self, doc_id: str) -> bool:
         if self.sessions.pop(doc_id, None) is not None:
             self._resyncs_seen.pop(doc_id, None)
             self._bump("evictions")
+            if self.recorder is not None:
+                self.recorder.record("session_evicted",
+                                     shard=self.shard_id, doc=doc_id,
+                                     why="explicit")
             return True
         return False
 
@@ -144,6 +157,7 @@ class SessionBank:
         Never raises for device failures: falls back to the host engine
         and records the fallback."""
         self._bump("syncs")
+        t0 = time.perf_counter()
         try:
             sess = self.session(doc_id, oplog)
             if self.device is not None and self.engine == "device":
@@ -152,6 +166,21 @@ class SessionBank:
                     steps = sess.sync()
             else:
                 steps = sess.sync()
+            # wall vs device attribution: the sync above returns once
+            # dispatch is queued; block_until_ready isolates the device
+            # wait. Only measured when the profiler is on — forcing a
+            # sync point perturbs the async dispatch pipeline.
+            device_s = 0.0
+            if self.engine == "device" and PROFILER.enabled:
+                carry = getattr(sess, "carry", None)
+                if carry is not None:
+                    td = time.perf_counter()
+                    try:
+                        import jax
+                        jax.block_until_ready(carry)
+                        device_s = time.perf_counter() - td
+                    except Exception:
+                        device_s = 0.0
             seen = self._resyncs_seen.get(doc_id)
             now_resyncs = getattr(sess, "resyncs", 0)
             if seen is not None and now_resyncs > seen:
@@ -160,12 +189,20 @@ class SessionBank:
             if self.metrics is not None:
                 self.metrics.observe_footprint(self.shard_id,
                                                self.footprint_slots())
+                self.metrics.observe_device_time(
+                    self.shard_id, time.perf_counter() - t0, device_s)
+            PROFILER.observe_flush(self.shard_id,
+                                   time.perf_counter() - t0, device_s)
             return {"engine": self.engine, "steps": int(steps)}
         except Exception as e:
             if self.engine == "host":
                 raise       # host checkouts failing is a real bug
             self.evict(doc_id)
             self._bump("host_fallbacks")
+            if self.recorder is not None:
+                self.recorder.record(
+                    "host_fallback", shard=self.shard_id, doc=doc_id,
+                    error=f"{e.__class__.__name__}: {e}"[:120])
             return {"engine": "host", "steps": _HostDoc(oplog).sync(),
                     "error": f"{e.__class__.__name__}: {e}"[:200]}
 

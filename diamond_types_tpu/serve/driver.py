@@ -30,6 +30,8 @@ import random
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import Observability
+from ..obs.devprof import PROFILER
 from ..text.oplog import OpLog
 from ..text.trace import TestData, load_trace
 from .scheduler import MergeScheduler
@@ -118,9 +120,14 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     flush_docs: int = 4, flush_deadline_s: float = 0.02,
                     max_pending: int = 64, max_sessions: int = 4,
                     seed: int = 7, place_on_devices: bool = True,
-                    session_opts: Optional[dict] = None) -> dict:
+                    session_opts: Optional[dict] = None,
+                    obs_sample_rate: float = 0.01) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
-    report with throughput, the metrics snapshot, and the parity gate."""
+    report with throughput, the metrics snapshot, the parity gate, and
+    the device-profiler snapshot (wall vs. device time per flush, jit
+    cache hit/miss — obs/devprof). The bench runs with the production
+    observability defaults (1% trace sampling) so its throughput
+    numbers ARE the instrumented numbers."""
     doc_ids = [f"doc{i:03d}" for i in range(docs)]
     ols: Dict[str, OpLog] = {}
     for d in doc_ids:
@@ -152,6 +159,10 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         max_pending=max_pending, flush_docs=flush_docs,
         flush_deadline_s=flush_deadline_s,
         place_on_devices=place_on_devices, session_opts=session_opts)
+    obs = Observability(sample_rate=obs_sample_rate, seed=seed)
+    sched.attach_obs(obs)
+    PROFILER.reset()
+    PROFILER.enabled = True
 
     t0 = time.perf_counter()
     total_ops = 0
@@ -205,5 +216,13 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         "parity_ok": not mismatches,
         "parity_mismatches": mismatches,
         "metrics": sched.metrics_json(),
+        "devprof": PROFILER.snapshot(),
+        "obs": {"trace": obs.tracer.stats()},
     }
+    PROFILER.enabled = False
+    if mismatches:
+        # a parity failure report should be diagnosable standalone:
+        # attach the flight-recorder tail (evictions, fallbacks,
+        # fencing — the usual suspects for a stale device text)
+        report["events_tail"] = obs.recorder.tail(50)
     return report

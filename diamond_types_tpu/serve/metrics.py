@@ -7,7 +7,7 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"version": 3,                   # counter-set schema; bump on change
+  {"version": 4,                   # counter-set schema; bump on change
    "uptime_s": s,                  # monotonic since construction
    "shards": N, "flush_docs": B,
    "totals": {"submits", "coalesced", "rejects", "denied", "fenced",
@@ -19,9 +19,11 @@ Schema (snapshot()):
    "flush_size_hist": {"1": n, "2": n, ...},
    "max_depth_seen": d,
    "queue_bound_violations": 0,     # depth observed above max_pending
+   "latencies": {"flush": hist},    # obs.hist snapshot w/ p50/p90/p99
    "per_shard": [{"shard", "queue_depth", "submits", "rejects",
                   "flushes", "flushed_docs", "builds", "evictions",
-                  "resyncs", "host_fallbacks", "footprint_slots"}, ...]}
+                  "resyncs", "host_fallbacks", "footprint_slots",
+                  "flush_wall_s", "device_sync_s"}, ...]}
 """
 
 from __future__ import annotations
@@ -29,6 +31,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Dict, List
+
+from ..obs.hist import Histogram
 
 
 _SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "fenced",
@@ -41,8 +45,9 @@ class ServeMetrics:
     # detect schema drift across PRs (v2 = uptime_s + version + the
     # `denied` ownership-gate counter; v3 = `fenced`, queued work
     # skipped at flush because its admit-time lease epoch is no longer
-    # the one this host holds)
-    SCHEMA_VERSION = 3
+    # the one this host holds; v4 = `latencies.flush` histogram and
+    # per-shard `flush_wall_s`/`device_sync_s` device-time attribution)
+    SCHEMA_VERSION = 4
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -61,6 +66,12 @@ class ServeMetrics:
         self.queue_bound_violations = 0
         self.queue_depth: List[int] = [0] * n_shards
         self.footprint_slots: List[int] = [0] * n_shards
+        self.flush_latency = Histogram()
+        self.flush_wall_s: List[float] = [0.0] * n_shards
+        self.device_sync_s: List[float] = [0.0] * n_shards
+        # obs.recorder.FlightRecorder, wired by
+        # MergeScheduler.attach_obs; only rare events touch it
+        self.recorder = None
 
     # ---- recording -------------------------------------------------------
 
@@ -69,7 +80,7 @@ class ServeMetrics:
             self.shard[shard][key] += n
 
     def record_flush(self, shard: int, n_docs: int, n_ops: int,
-                     reason: str) -> None:
+                     reason: str, dur_s: float = 0.0) -> None:
         with self._lock:
             c = self.shard[shard]
             c["flushes"] += 1
@@ -79,17 +90,33 @@ class ServeMetrics:
                 self.flush_reasons.get(reason, 0) + 1
             self.flush_size_hist[n_docs] = \
                 self.flush_size_hist.get(n_docs, 0) + 1
+        # histogram carries its own lock; record outside ours
+        self.flush_latency.record(dur_s)
+
+    def observe_device_time(self, shard: int, wall_s: float,
+                            device_s: float) -> None:
+        """Per-shard wall vs. block_until_ready device seconds for one
+        doc sync (obs/devprof feeds the process-wide view; this keeps
+        the attribution in the /metrics per_shard rows)."""
+        with self._lock:
+            self.flush_wall_s[shard] += wall_s
+            self.device_sync_s[shard] += device_s
 
     def observe_queue(self, shard: int, depth: int) -> None:
         with self._lock:
             self.queue_depth[shard] = depth
             if depth > self.max_depth_seen:
                 self.max_depth_seen = depth
-            if depth > self.max_pending:
+            violated = depth > self.max_pending
+            if violated:
                 # must stay 0: the bounded-queue contract (admission
                 # raises Backpressure before this point); nonzero = a
                 # real bug
                 self.queue_bound_violations += 1
+        if violated and self.recorder is not None:
+            self.recorder.record("queue_bound_violation", shard=shard,
+                                 depth=depth,
+                                 max_pending=self.max_pending)
 
     def observe_footprint(self, shard: int, slots: int) -> None:
         with self._lock:
@@ -98,15 +125,18 @@ class ServeMetrics:
     # ---- export ----------------------------------------------------------
 
     def snapshot(self) -> dict:
+        # the histogram has its own lock: snapshot it before taking
+        # ours (never nest)
+        flush_hist = self.flush_latency.snapshot()
         with self._lock:
             totals = {k: sum(s[k] for s in self.shard)
                       for k in _SHARD_KEYS}
             flushes = max(totals["flushes"], 1)
             occupancy = (totals["flushed_docs"] / flushes) \
                 / self.flush_docs
-            return self._snapshot_locked(totals, occupancy)
+            return self._snapshot_locked(totals, occupancy, flush_hist)
 
-    def _snapshot_locked(self, totals, occupancy) -> dict:
+    def _snapshot_locked(self, totals, occupancy, flush_hist) -> dict:
         return {
             "version": self.SCHEMA_VERSION,
             "uptime_s": round(time.monotonic() - self.started_at, 3),
@@ -122,9 +152,12 @@ class ServeMetrics:
                                 sorted(self.flush_size_hist.items())},
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,
+            "latencies": {"flush": flush_hist},
             "per_shard": [
                 {"shard": i, "queue_depth": self.queue_depth[i],
                  "footprint_slots": self.footprint_slots[i],
+                 "flush_wall_s": round(self.flush_wall_s[i], 6),
+                 "device_sync_s": round(self.device_sync_s[i], 6),
                  **self.shard[i]}
                 for i in range(self.n_shards)],
         }

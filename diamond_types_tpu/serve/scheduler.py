@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..obs.trace import NOOP_SPAN
 from .admission import AdmissionQueue, Backpressure
 from .bank import SessionBank
 from .metrics import ServeMetrics
@@ -85,28 +86,57 @@ class MergeScheduler:
         # `epoch_of(doc_id) -> int` — the ACTIVE lease epoch this host
         # holds (replicate.ReplicaNode.active_epoch); None = unfenced
         self.epoch_of: Optional[Callable[[str], int]] = None
+        # obs.Observability bundle (attach_obs); None = zero overhead:
+        # every obs touchpoint below is guarded by this one attribute
+        self.obs = None
         self.lock = threading.Lock()
         self._shard_locks = [threading.Lock() for _ in range(n_shards)]
         self._pump_stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
 
+    def attach_obs(self, obs) -> None:
+        """Wire an obs.Observability bundle into the admit→flush path:
+        spans on submit/flush/device-sync, flush latencies into the
+        metrics histogram, rare events (evictions, queue-bound
+        violations, fenced flushes) into the flight recorder."""
+        self.obs = obs
+        self.metrics.recorder = obs.recorder
+        for bank in self.banks:
+            bank.recorder = obs.recorder
+
     # ---- intake ----------------------------------------------------------
 
     def submit(self, doc_id: str, n_ops: int = 1,
-               now: Optional[float] = None) -> dict:
+               now: Optional[float] = None, trace=None) -> dict:
         """Queue pending merge work. Returns {"accepted": True, "shard",
         "bucket"}, {"accepted": False, "retry_after"} on backpressure,
         or {"accepted": False, "reason": "not_owner"} when the
         ownership gate denies (never raises — rejects and denials are
-        normal operation under load / during handoff)."""
+        normal operation under load / during handoff). `trace` is an
+        optional obs SpanContext (the originating HTTP edit); when its
+        trace is sampled the admit, the ownership gate, and later the
+        flush + device sync all join it."""
         now = time.monotonic() if now is None else now
-        if self.admit is not None and not self.admit(doc_id):
-            # shard_of (not assign): a denied doc must not register a
-            # live assignment this host will never flush
-            shard = self.router.shard_of(doc_id)
-            self.metrics.bump(shard, "denied")
-            return {"accepted": False, "shard": shard,
-                    "reason": "not_owner"}
+        obs = self.obs
+        span = NOOP_SPAN
+        if obs is not None:
+            span = obs.tracer.start("serve.admit", parent=trace,
+                                    attrs={"doc": doc_id,
+                                           "n_ops": n_ops})
+        if self.admit is not None:
+            gate = NOOP_SPAN if not span.sampled else obs.tracer.start(
+                "serve.ownership_gate", parent=span.context(),
+                attrs={"doc": doc_id})
+            admitted = self.admit(doc_id)
+            gate.end(admitted=admitted)
+            if not admitted:
+                # shard_of (not assign): a denied doc must not register
+                # a live assignment this host will never flush
+                shard = self.router.shard_of(doc_id)
+                self.metrics.bump(shard, "denied")
+                span.end(outcome="denied")
+                return {"accepted": False, "shard": shard,
+                        "reason": "not_owner"}
         # stamp the admit-time lease epoch; the flush rechecks it
         epoch = self.epoch_of(doc_id) if self.epoch_of is not None \
             else -1
@@ -116,15 +146,18 @@ class MergeScheduler:
             already = self.queue.pending_bucket(shard, doc_id) is not None
             try:
                 bucket = self.queue.submit(shard, doc_id, n_ops, now,
-                                           epoch=epoch)
+                                           epoch=epoch,
+                                           trace=span.context())
             except Backpressure as bp:
                 self.metrics.bump(shard, "rejects")
+                span.end(outcome="backpressure")
                 return {"accepted": False, "shard": shard,
                         "retry_after": bp.retry_after}
             if already:
                 self.metrics.bump(shard, "coalesced")
             self.metrics.observe_queue(shard, self.queue.depth(shard))
-            return {"accepted": True, "shard": shard, "bucket": bucket}
+        span.end(outcome="queued", shard=shard, bucket=bucket)
+        return {"accepted": True, "shard": shard, "bucket": bucket}
 
     # ---- flush -----------------------------------------------------------
 
@@ -161,25 +194,49 @@ class MergeScheduler:
         The fencing recheck runs first: work admitted under a lease
         epoch this host no longer holds is dropped (`fenced`), never
         merged — its ops are still in the oplog for the new owner."""
+        obs = self.obs
         if self.epoch_of is not None:
             kept = []
             for item in items:
                 if item.epoch != -1 \
                         and self.epoch_of(item.doc_id) != item.epoch:
                     self.metrics.bump(shard, "fenced")
+                    if obs is not None:
+                        obs.recorder.record("flush_fenced",
+                                            doc=item.doc_id,
+                                            shard=shard,
+                                            admit_epoch=item.epoch)
                 else:
                     kept.append(item)
             items = kept
             if not items:
                 return
+        fspan = NOOP_SPAN
+        if obs is not None:
+            parent = next(
+                (i.trace for i in items if i.trace is not None), None)
+            if parent is not None:
+                fspan = obs.tracer.start(
+                    "serve.flush", parent=parent,
+                    attrs={"shard": shard, "reason": reason,
+                           "docs": len(items)})
         bank = self.banks[shard]
+        t0 = time.perf_counter()
         with self._shard_locks[shard]:
             for item in items:
                 ol = self.resolve(item.doc_id)
+                dspan = NOOP_SPAN if not fspan.sampled else \
+                    obs.tracer.start("serve.device_sync",
+                                     parent=fspan.context(),
+                                     attrs={"doc": item.doc_id})
                 with self._sync_lock:
                     bank.sync_doc(item.doc_id, ol)
+                dspan.end()
+        dur = time.perf_counter() - t0
+        fspan.end(dur_s=round(dur, 6))
         self.metrics.record_flush(
-            shard, len(items), sum(i.n_ops for i in items), reason)
+            shard, len(items), sum(i.n_ops for i in items), reason,
+            dur_s=dur)
 
     def drain(self) -> int:
         """Flush everything regardless of triggers (shutdown, rebalance,

@@ -309,6 +309,75 @@ def cmd_replicate_soak(args) -> int:
         else 1
 
 
+def cmd_obs_report(args) -> int:
+    """One-shot observability report for a running server: scrape
+    GET /metrics + GET /debug/events and print a human summary of
+    endpoint/flush/handoff latencies, fencing activity and the tail of
+    the flight-recorder ring (obs/)."""
+    import urllib.request
+    base = args.url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    with urllib.request.urlopen(f"{base}/metrics",
+                                timeout=args.timeout) as r:
+        doc = json.loads(r.read())
+    try:
+        with urllib.request.urlopen(f"{base}/debug/events",
+                                    timeout=args.timeout) as r:
+            events = json.loads(r.read())
+    except (OSError, ValueError):
+        events = {"events": []}
+    if args.json:
+        print(json.dumps({"metrics": doc, "events": events}))
+        return 0
+
+    def _fmt_hist(name, snap, labels=None):
+        lb = " ".join(f"{k}={v}" for k, v in sorted((labels or {})
+                                                    .items()))
+        print(f"  {name:<28s} {lb:<28s} n={snap.get('count', 0):<7d} "
+              f"p50={snap.get('p50', 0) * 1e3:8.3f}ms "
+              f"p90={snap.get('p90', 0) * 1e3:8.3f}ms "
+              f"p99={snap.get('p99', 0) * 1e3:8.3f}ms "
+              f"max={snap.get('max', 0) * 1e3:8.3f}ms")
+
+    obs = doc.get("obs") or {}
+    print("== latencies ==")
+    for name, rows in sorted((obs.get("http") or {}).items()):
+        for row in rows:
+            _fmt_hist(name, row, row.get("labels"))
+    serve = doc.get("serve") or {}
+    for name, snap in sorted((serve.get("latencies") or {}).items()):
+        _fmt_hist(f"serve.{name}", snap)
+    repl = doc.get("replication") or {}
+    for name, snap in sorted((repl.get("latencies") or {}).items()):
+        _fmt_hist(f"repl.{name}", snap)
+
+    if repl:
+        fencing = repl.get("fencing") or {}
+        quorum = repl.get("quorum") or {}
+        print("== fencing / quorum ==")
+        print("  " + " ".join(f"{k}={v}"
+                              for k, v in sorted(fencing.items())))
+        print("  " + " ".join(f"{k}={v}"
+                              for k, v in sorted(quorum.items())))
+
+    trace = obs.get("trace") or {}
+    if trace:
+        print("== tracing ==")
+        print("  " + " ".join(f"{k}={v}"
+                              for k, v in sorted(trace.items())))
+
+    tail = (events.get("events") or [])[-args.events:]
+    print(f"== events (last {len(tail)} of "
+          f"{events.get('recorded', 0)}) ==")
+    for ev in tail:
+        rest = {k: v for k, v in ev.items()
+                if k not in ("seq", "t", "kind")}
+        print(f"  [{ev.get('seq', '?'):>5}] {ev.get('kind', '?'):<24s} "
+              + " ".join(f"{k}={v}" for k, v in sorted(rest.items())))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="dt-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -416,6 +485,18 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
     c.set_defaults(fn=cmd_replicate_soak)
+
+    c = sub.add_parser(
+        "obs-report",
+        help="scrape a server's /metrics + /debug/events and print a "
+        "human latency / fencing / flight-recorder summary")
+    c.add_argument("url", help="server base URL (host:port is enough)")
+    c.add_argument("--events", type=int, default=20,
+                   help="flight-recorder tail length to print")
+    c.add_argument("--timeout", type=float, default=5.0)
+    c.add_argument("--json", action="store_true",
+                   help="print the raw scraped JSON instead")
+    c.set_defaults(fn=cmd_obs_report)
 
     args = p.parse_args(argv)
     return args.fn(args)

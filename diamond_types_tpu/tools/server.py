@@ -39,9 +39,19 @@ web_assets.py for the pages):
                             equivalent: the reference wiki server streams
                             patches to subscribed clients)
   GET  /doc/{id}/graph      -> causal DAG runs JSON (visualizer data)
-  GET  /metrics             -> {"serve": scheduler metrics | null} —
-                            the sharded merge scheduler's counters when
-                            the server runs with --serve-shards N
+  GET  /metrics             -> {"serve": scheduler metrics | null,
+                            "replication": ... | null, "obs": ...} —
+                            JSON by default (Cache-Control: no-store);
+                            `?format=prom` switches to Prometheus text
+                            exposition (text/plain; version=0.0.4) with
+                            every counter/gauge/histogram as dt_*
+                            metrics (obs/prom.py)
+  GET  /debug/events        -> {"events": [...], "recorded", "dropped",
+                            ...} — the flight recorder's bounded ring
+                            of structured events (lease transitions,
+                            fencing rejections, circuit opens,
+                            evictions, queue-bound violations),
+                            oldest-first (obs/recorder.py)
   POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
   POST /doc/{id}/history    body {"n": k} -> {"snapshots": [{"lv",
                             "text"}...]} oldest-first history strip; with
@@ -98,6 +108,7 @@ import re
 import sys
 import threading
 import time
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -105,6 +116,7 @@ from typing import Dict, Optional
 from ..causalgraph.summary import intersect_with_summary, summarize_versions
 from ..encoding.decode import decode_into, load_oplog
 from ..encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
+from ..obs.trace import TRACE_HEADER, parse_header
 from ..text.oplog import OpLog
 
 # Doc ids are filenames (DocStore writes {data_dir}/{id}.dt) and are
@@ -138,6 +150,10 @@ class DocStore:
         # docs this host doesn't own are proxied to the lease holder
         # and the scheduler's admit gate keeps merges owner-only.
         self.replica = None
+        # Optional observability bundle (obs/): sampled tracer, flight
+        # recorder, per-endpoint latency histograms. serve() attaches
+        # one; attach_replication forwards it to the ReplicaNode.
+        self.obs = None
         self.lock = threading.Lock()
         self.io_lock = threading.Lock()   # serializes flush passes
         # Long-poll wakeups (one condition per doc; notified on new ops).
@@ -173,7 +189,7 @@ class DocStore:
         sync_lock=self.lock (so bank syncs never race handler threads)."""
         self.scheduler = scheduler
 
-    def submit_merge(self, doc_id: str, n_ops: int = 1):
+    def submit_merge(self, doc_id: str, n_ops: int = 1, trace=None):
         """Queue merge work for the doc's shard. No-op (returns None)
         when no scheduler is attached. Backpressure rejects are the
         scheduler's problem, not the edit's: the edit is already durably
@@ -181,11 +197,13 @@ class DocStore:
         next accepted submit or a read-triggered flush catches it up.
         MUST be called OUTSIDE self.lock (the pump thread takes
         scheduler.lock then self.lock; a caller holding self.lock here
-        would invert that order and deadlock)."""
+        would invert that order and deadlock). `trace` is an optional
+        obs SpanContext linking the queued work back to the HTTP
+        request that produced it."""
         sched = self.scheduler
         if sched is None:
             return None
-        return sched.submit(doc_id, n_ops=n_ops)
+        return sched.submit(doc_id, n_ops=n_ops, trace=trace)
 
     def cond(self, doc_id: str) -> threading.Condition:
         with self.lock:
@@ -550,10 +568,13 @@ class SyncHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
-    def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+    def _send(self, code: int, body: bytes, ctype: str = "application/json",
+              extra: Optional[dict] = None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -563,7 +584,48 @@ class SyncHandler(BaseHTTPRequestHandler):
             return parts[1], (parts[2] if len(parts) > 2 else "")
         return None, None
 
+    def _endpoint_label(self) -> str:
+        """Bounded-cardinality endpoint label for the per-endpoint
+        latency histograms: doc ids collapse to the sub-action, unknown
+        paths collapse to "other" (a scanner must not mint histogram
+        series)."""
+        path = self.path.split("?", 1)[0]
+        parts = path.strip("/").split("/")
+        head = parts[0] if parts else ""
+        if head == "":
+            return "index"
+        if head == "doc":
+            sub = parts[2] if len(parts) > 2 else "text"
+            return "doc_" + (sub if sub in (
+                "summary", "state", "graph", "pull", "push", "edit",
+                "changes", "ops", "history", "at", "text") else "other")
+        if head in ("replicate", "debug") and len(parts) == 2:
+            return f"{head}_{parts[1]}"
+        if head in ("metrics", "edit", "vis", "crdt"):
+            return head
+        return "other"
+
+    def _trace_ctx(self):
+        """SpanContext of this request's http span (None when the
+        request wasn't sampled) — threaded into scheduler submits and
+        proxy hops so one edit yields one trace."""
+        span = getattr(self, "_span", None)
+        if span is not None and span.sampled:
+            return span.context()
+        return None
+
     def do_GET(self):
+        obs = self.store.obs
+        t0 = time.monotonic()
+        try:
+            self._do_get()
+        finally:
+            if obs is not None:
+                obs.hist.observe("http_request", time.monotonic() - t0,
+                                 endpoint=self._endpoint_label(),
+                                 method="GET")
+
+    def _do_get(self):
         from .web_assets import (CRDT_HTML, EDITOR_HTML, INDEX_HTML,
                                  VIS_HTML)
 
@@ -571,17 +633,41 @@ class SyncHandler(BaseHTTPRequestHandler):
         if self.path == "/" or self.path == "":
             return self._send(200, INDEX_HTML.encode("utf8"),
                               "text/html; charset=utf-8")
-        if self.path == "/metrics":
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
             # serve/ scheduler counters (queue depths, flush sizes,
             # occupancy, evictions...) + replicate/ counters (leases,
-            # handoffs, anti-entropy, per-peer backoff state) — JSON
-            # for bench/soak scrapers
+            # handoffs, anti-entropy, per-peer backoff state) + obs
+            # snapshots — JSON for bench/soak scrapers by default,
+            # `?format=prom` renders the SAME document as Prometheus
+            # text exposition. no-store either way: a cached scrape is
+            # a wrong scrape.
             sched = self.store.scheduler
             node = self.store.replica
-            body = json.dumps(
-                {"serve": sched.metrics_json() if sched else None,
-                 "replication": node.metrics_json() if node else None})
-            return self._send(200, body.encode("utf8"))
+            obs = self.store.obs
+            doc = {"serve": sched.metrics_json() if sched else None,
+                   "replication": node.metrics_json() if node else None}
+            if obs is not None:
+                doc["obs"] = obs.snapshot()
+            qs = urllib.parse.parse_qs(
+                self.path.partition("?")[2], keep_blank_values=True)
+            no_store = {"Cache-Control": "no-store"}
+            if qs.get("format", [""])[0] == "prom":
+                from ..obs.prom import CONTENT_TYPE, render_metrics
+                return self._send(200, render_metrics(doc).encode("utf8"),
+                                  CONTENT_TYPE, extra=no_store)
+            return self._send(200, json.dumps(doc).encode("utf8"),
+                              extra=no_store)
+        if parts[:1] == ["debug"]:
+            obs = self.store.obs
+            if obs is not None and len(parts) == 2 \
+                    and parts[1] == "events":
+                rec = obs.recorder
+                out = dict(rec.stats())
+                out["events"] = rec.dump()
+                return self._send(200, json.dumps(out).encode("utf8"),
+                                  extra={"Cache-Control": "no-store"})
+            return self._send(404, b"{}")
         if parts and parts[0] == "replicate":
             node = self.store.replica
             if node is None:
@@ -639,6 +725,17 @@ class SyncHandler(BaseHTTPRequestHandler):
         # browser endpoint — and corrupt binary patches on /push
         # (ParseError) — are client errors, not handler-thread crashes.
         from ..encoding.decode import ParseError
+        obs = self.store.obs
+        t0 = time.monotonic()
+        if obs is not None:
+            # Root (or continued) span for this request: an X-DT-Trace
+            # header from a proxying peer or traced client stitches this
+            # hop into the caller's trace; otherwise head-sampling here
+            # decides for every downstream span (admit, flush, proxy).
+            self._span = obs.tracer.start(
+                "http." + self._endpoint_label(),
+                parent=parse_header(self.headers.get(TRACE_HEADER)),
+                attrs={"path": self.path.split("?", 1)[0]})
         try:
             self._do_post()
         except (ValueError, KeyError, TypeError, AttributeError,
@@ -649,6 +746,14 @@ class SyncHandler(BaseHTTPRequestHandler):
                     .encode("utf8"))
             except OSError:
                 pass  # client already gone
+        finally:
+            if obs is not None:
+                span = getattr(self, "_span", None)
+                if span is not None:
+                    span.end()
+                obs.hist.observe("http_request", time.monotonic() - t0,
+                                 endpoint=self._endpoint_label(),
+                                 method="POST")
 
     def _do_post(self):
         parts = self.path.strip("/").split("/")
@@ -701,7 +806,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     node.metrics.bump("proxy", "loops_refused")
                 else:
                     relay = node.proxy(target, self.path, body,
-                                       doc_id=doc_id)
+                                       doc_id=doc_id,
+                                       trace=self._trace_ctx())
                     if relay is not None:
                         status, resp = relay
                         return self._send(status, resp)
@@ -747,7 +853,8 @@ class SyncHandler(BaseHTTPRequestHandler):
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
             if n_new:
-                self.store.submit_merge(doc_id, n_new)
+                self.store.submit_merge(doc_id, n_new,
+                                        trace=self._trace_ctx())
             return self._send(200, json.dumps(
                 {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
@@ -799,7 +906,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                 out = ol.cg.local_to_remote_frontier(frontier)
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
-            self.store.submit_merge(doc_id, len(ops))
+            self.store.submit_merge(doc_id, len(ops),
+                                    trace=self._trace_ctx())
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
         if action == "changes":
@@ -866,7 +974,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     # (both helpers take store.lock themselves)
                     self.store.mark_dirty(doc_id)
                     self.store.notify(doc_id)
-                    self.store.submit_merge(doc_id, applied)
+                    self.store.submit_merge(doc_id, applied,
+                                            trace=self._trace_ctx())
             return self._send(200, json.dumps(
                 {"ops": out_ops, "version": ver}).encode("utf8"))
         if action == "history":
@@ -922,15 +1031,21 @@ class _Server(ThreadingHTTPServer):
 
 def serve(port: int = 8008, data_dir: Optional[str] = None,
           serve_shards: int = 0, peers: Optional[list] = None,
-          replicate_opts: Optional[dict] = None) -> ThreadingHTTPServer:
+          replicate_opts: Optional[dict] = None,
+          obs_opts: Optional[dict] = None) -> ThreadingHTTPServer:
     """`peers` is the static mesh (["host:port", ...], may include
     this server's own address — it is dropped from the table). With
     peers set, a replicate.ReplicaNode is attached and started: health
     probes, lease maintenance and anti-entropy run in the background,
     and mutations for docs owned elsewhere are proxied. Tests that
     bind port 0 call replicate.attach_replication themselves once the
-    ephemeral port is known."""
+    ephemeral port is known. `obs_opts` are Observability kwargs
+    (sample_rate etc.); every server gets a bundle — the tracer head-
+    samples (1% default) and the recorder only fires on rare events,
+    so the default is cheap enough to leave on."""
+    from ..obs import Observability
     store = DocStore(data_dir)
+    store.obs = Observability(**(obs_opts or {}))
     if serve_shards:
         # engine="host" on purpose: this process serves HTTP, and
         # first-touch JAX backend init against a wedged accelerator
@@ -942,6 +1057,7 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
         sched = MergeScheduler(serve_shards, resolve=store.get,
                                engine="host", sync_lock=store.lock)
         store.attach_scheduler(sched)
+        sched.attach_obs(store.obs)
         sched.start_pump()
     handler = type("Handler", (SyncHandler,), {"store": store})
     httpd = _Server(("127.0.0.1", port), handler)
@@ -1045,13 +1161,17 @@ def main() -> None:
                    help="host:port of an existing mesh member to "
                    "announce ourselves to at startup (dynamic "
                    "membership; the mesh is learned from its reply)")
+    p.add_argument("--obs-sample-rate", type=float, default=0.01,
+                   help="trace head-sampling rate (0 disables tracing; "
+                   "histograms and the flight recorder are always on)")
     args = p.parse_args()
     peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
         if args.peers else ([] if args.join else None)
     httpd = serve(args.port, args.data_dir,
                   serve_shards=args.serve_shards, peers=peers,
                   replicate_opts={"lease_ttl_s": args.lease_ttl,
-                                  "join": args.join})
+                                  "join": args.join},
+                  obs_opts={"sample_rate": args.obs_sample_rate})
     print(f"serving on http://127.0.0.1:{args.port}"
           + (f" (mesh: {','.join(peers)})" if peers else ""))
     httpd.serve_forever()

@@ -48,6 +48,8 @@ def _micro_fn(W: int, plen: int, n_rows: int, MB: int, MC: int, MD: int,
 
     key = (W, plen, n_rows, MB, MC, MD, T)
     fn = _sess_jit_cache.get(key)
+    from ..obs.devprof import note_jit_lookup
+    note_jit_lookup("micro", fn is not None)
     if fn is None:
         from jax import lax
 
@@ -72,6 +74,8 @@ def _tip_row_fn(W: int, n_rows: int):
 
     key = (W, n_rows)
     fn = _tip_jit_cache.get(key)
+    from ..obs.devprof import note_jit_lookup
+    note_jit_lookup("tip", fn is not None)
     if fn is None:
         import jax.numpy as jnp
         from jax import lax
@@ -221,7 +225,12 @@ class DeviceZoneSession:
             return carry
         fn = _micro_fn(tape.W, tape.plen, n_rows, self.MB, self.MC,
                        self.MD, _pow2(T))
-        xs = {k: jnp.asarray(v) for k, v in _pad_tape_xs(tape).items()}
+        padded = _pad_tape_xs(tape)
+        from ..obs.devprof import PROFILER
+        if PROFILER.enabled:   # host->device tape upload, one flush
+            PROFILER.note_transfer(sum(int(np.asarray(v).nbytes)
+                                       for v in padded.values()))
+        xs = {k: jnp.asarray(v) for k, v in padded.items()}
         return fn(carry, xs)
 
     def _take_row(self, exclude) -> Optional[int]:

@@ -415,8 +415,8 @@ def test_membership_states_and_refutation():
 def test_two_server_smoke(tmp_path):
     """Two wired servers: ownership proxy routes mutations, anti-entropy
     converges the pair, /metrics exposes replication counters (schema
-    v2: quorum/fencing/membership) + the serve schema v3 fields on both
-    servers."""
+    v3: latency histograms + derived v2 keys) + the serve schema v4
+    fields on both servers."""
     from diamond_types_tpu.tools.server import SyncClient
     httpds, nodes, addrs = _mesh(2, tmp_path)
     try:
@@ -439,7 +439,7 @@ def test_two_server_smoke(tmp_path):
                 assert mergers == [holder]
         for a in addrs:
             m = _metrics(a)
-            assert m["replication"]["version"] == 2
+            assert m["replication"]["version"] == 3
             assert m["replication"]["leases"]["held"] >= 0
             assert m["replication"]["antientropy"]["rounds"] >= 1
             assert "promise_conflicts" in m["replication"]["quorum"]
@@ -447,7 +447,10 @@ def test_two_server_smoke(tmp_path):
             assert m["replication"]["quorum_view"]["quorum"] == 2
             assert not m["replication"]["quorum_view"]["rejoining"]
             assert m["replication"]["membership_view"]["view_version"] >= 1
-            assert m["serve"]["version"] == 3
+            # v3: histogram latencies + derived v2 keys
+            assert "handoff" in m["replication"]["latencies"]
+            assert m["replication"]["handoffs"]["latency_s_total"] >= 0
+            assert m["serve"]["version"] == 4
             assert m["serve"]["uptime_s"] >= 0
             assert "denied" in m["serve"]["totals"]
             assert "fenced" in m["serve"]["totals"]
