@@ -772,17 +772,27 @@ def bench_codec(name: str):
 
 
 def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
-                      engine: str = "device", timeout: int = 300):
+                      engine: str = "device", timeout: int = 300,
+                      fused: bool = True, steady_rounds: int = 8):
     """Sharded multi-document merge scheduler (serve/): replays the
     synthetic trace across `docs` docs on `shards` CPU-simulated shards
     through the router + shape-bucketed admission queue + per-shard
     session banks, byte-parity-gated per doc against the single-engine
     host checkout. Runs as a subprocess: the CLI pins JAX_PLATFORMS=cpu
     itself, so a wedged accelerator tunnel can never stall the host
-    phase, and the jit caches it warms die with the child."""
+    phase, and the jit caches it warms die with the child.
+
+    `fused` toggles the vmapped bucket flush (--no-fused = the serial
+    per-doc zone-session path); `steady_rounds` lockstep rounds against
+    resident sessions are where fused occupancy is actually measured —
+    the continuous feed races the flush workers (see serve/driver.py)."""
     cmd = [sys.executable, "-m", "diamond_types_tpu.tools.cli",
            "serve-bench", "--shards", str(shards), "--docs", str(docs),
-           "--txns", str(txns), "--engine", engine, "--json"]
+           "--txns", str(txns), "--engine", engine,
+           "--fused" if fused else "--no-fused",
+           "--steady-rounds", str(steady_rounds), "--json"]
+    if fused:
+        cmd.append("--warmup")
     p = subprocess.run(cmd, capture_output=True, text=True,
                        timeout=timeout,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -1357,6 +1367,8 @@ def _main() -> None:
         full["serve_sched"] = sv
         m = sv["metrics"]
         dp = sv.get("devprof") or {}
+        flush_p99 = (m.get("latencies", {}).get("flush", {})
+                     .get("p99"))
         extra["serve_sched"] = {
             "ops_per_sec": sv["ops_per_sec"],
             "parity": sv["parity_ok"],
@@ -1364,12 +1376,31 @@ def _main() -> None:
             "queue_bound_violations": m["queue_bound_violations"],
             "host_fallback_ratio": m["host_fallback_ratio"],
             # obs/devprof: where flush wall time actually goes
-            "flush_p99_s": (m.get("latencies", {}).get("flush", {})
-                            .get("p99")),
+            "flush_p99_s": flush_p99,
             "device_fraction": dp.get("device_fraction"),
             "jit_cache": dp.get("jit_cache"),
             "transfer_bytes": dp.get("transfer_bytes"),
+            # fused bucket flush: docs folded per vmapped device call
+            "fused_device_calls": sv.get("fused_device_calls"),
+            "fused_occupancy": sv.get("fused_occupancy"),
         }
+        # serial (per-doc zone-session) comparison on the same trace:
+        # the fused-vs-serial speedup is THE number ROADMAP item (c)
+        # exists to move
+        try:
+            sv2 = bench_serve_sched(fused=False)
+            full["serve_sched_serial"] = sv2
+            p99s = (sv2["metrics"].get("latencies", {})
+                    .get("flush", {}).get("p99"))
+            extra["serve_sched"]["serial_flush_p99_s"] = p99s
+            extra["serve_sched"]["serial_ops_per_sec"] = \
+                sv2["ops_per_sec"]
+            if sv2.get("feed_wall_s"):
+                extra["serve_sched"]["fused_speedup"] = round(
+                    sv2["feed_wall_s"] / max(sv["feed_wall_s"], 1e-9),
+                    3)
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched"]["serial_error"] = str(e)[:120]
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 

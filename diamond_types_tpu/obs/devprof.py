@@ -24,6 +24,8 @@ class DeviceProfiler:
         self._shard: Dict[int, dict] = {}
         self.transfers = 0
         self.transfer_bytes = 0
+        self._fused = {"device_calls": 0, "docs": 0,
+                       "wall_s": 0.0, "device_s": 0.0}
 
     def reset(self) -> None:
         with self._lock:
@@ -31,6 +33,8 @@ class DeviceProfiler:
             self._shard = {}
             self.transfers = 0
             self.transfer_bytes = 0
+            self._fused = {"device_calls": 0, "docs": 0,
+                           "wall_s": 0.0, "device_s": 0.0}
 
     def note_jit(self, cache: str, hit: bool) -> None:
         if not self.enabled:
@@ -44,6 +48,28 @@ class DeviceProfiler:
         if not self.enabled:
             return
         with self._lock:
+            s = self._shard.setdefault(
+                int(shard), {"flushes": 0, "wall_s": 0.0, "device_s": 0.0})
+            s["flushes"] += 1
+            s["wall_s"] += wall_s
+            s["device_s"] += device_s
+
+    def observe_fused(self, shard: int, wall_s: float, device_s: float,
+                      n_docs: int) -> None:
+        """One fused bucket replay: `wall_s` is the whole dispatch +
+        commit, `device_s` the completion-fence wait (the
+        block_until_ready-equivalent) — the wall-vs-device attribution
+        for the fused path, per ROADMAP item (c). Also counts toward
+        the shard's flush totals so per_shard rows stay comparable
+        between fused and per-doc flushes."""
+        if not self.enabled:
+            return
+        with self._lock:
+            f = self._fused
+            f["device_calls"] += 1
+            f["docs"] += int(n_docs)
+            f["wall_s"] += wall_s
+            f["device_s"] += device_s
             s = self._shard.setdefault(
                 int(shard), {"flushes": 0, "wall_s": 0.0, "device_s": 0.0})
             s["flushes"] += 1
@@ -68,6 +94,16 @@ class DeviceProfiler:
                 for k, v in sorted(self._shard.items())}
             wall = sum(v["wall_s"] for v in self._shard.values())
             dev = sum(v["device_s"] for v in self._shard.values())
+            f = self._fused
+            calls = f["device_calls"]
+            fused = {"device_calls": calls, "docs": f["docs"],
+                     "occupancy": round(f["docs"] / calls, 4)
+                     if calls else 0.0,
+                     "wall_s": round(f["wall_s"], 6),
+                     "device_sync_s": round(f["device_s"], 6),
+                     "device_fraction": round(
+                         f["device_s"] / f["wall_s"], 4)
+                     if f["wall_s"] else 0.0}
             return {"enabled": self.enabled,
                     "jit_cache": jit,
                     "flush_wall_s": round(wall, 6),
@@ -75,6 +111,7 @@ class DeviceProfiler:
                     "device_fraction": round(dev / wall, 4) if wall else 0.0,
                     "transfers": self.transfers,
                     "transfer_bytes": self.transfer_bytes,
+                    "fused": fused,
                     "per_shard": per_shard}
 
 

@@ -106,6 +106,17 @@ def _render_serve(b: _Builder, serve: dict) -> None:
     for reason, n in sorted((serve.get("flush_reasons") or {}).items()):
         b.add("dt_serve_flush_reason_total", "counter", n,
               labels={"reason": reason})
+    fused = serve.get("fused") or {}
+    if fused:
+        # fused_calls/fused_docs totals already render from "totals";
+        # this block adds the occupancy gauge + histogram (docs folded
+        # per vmapped device call)
+        b.add("dt_serve_fused_occupancy", "gauge",
+              fused.get("occupancy", 0.0))
+        for docs, n in sorted((fused.get("occupancy_hist") or {})
+                              .items(), key=lambda kv: int(kv[0])):
+            b.add("dt_serve_fused_flush_total", "counter", n,
+                  labels={"docs": str(docs)})
     for i, row in enumerate(serve.get("per_shard") or []):
         lb = {"shard": str(row.get("shard", i))}
         if "queue_depth" in row:

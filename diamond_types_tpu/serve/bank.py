@@ -23,16 +23,61 @@ and counts a host fallback. `engine="host"` forces that path for every
 doc: the scheduler then still provides routing/batching/metrics, which
 is what the HTTP server uses (first-touch JAX init against a wedged
 accelerator tunnel must never hang a request handler).
+
+Fused flush (`fused=True`): sessions are `tpu.flush_fuse`
+FusedDocSessions and `sync_docs` replays a whole taken bucket in ONE
+jitted vmapped device call. The fallback ladder, most-fused first:
+
+  1. fused group   — ≥2 resident fused sessions sharing (cap, max_ins)
+                     whose tails fit: one `fused_replay` call.
+  2. per-doc       — host engine, mixed residency (a non-fused session
+                     already resident), capacity eviction mid-batch,
+                     a tail that overflows its buffer, or a bucket
+                     with <2 fusable docs: `sync_doc` per item.
+  3. host fallback — a poisoned/mismatched fused length or any device
+                     exception: evict the session and serve the doc
+                     from `oplog.checkout_tip()` (always correct).
+
+Locking contract for `sync_docs`: `oplog_lock` (the scheduler's
+narrowed sync lock — e.g. DocStore.lock) is held only around the
+HOST-side phases (session build, tail planning, fallback bookkeeping);
+`device_lock` (per physical device) is held only around the device
+replay, so shards on distinct chips flush genuinely concurrently. The
+one remaining process-global serialization point is `_ensure_jax_ready`
+below: the very first JAX backend touch process-wide is not
+thread-safe, so it runs once under a module lock (documented exception
+to the per-device rule).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..obs.devprof import PROFILER
 from .metrics import ServeMetrics
+
+# first-touch JAX init is the documented exception to per-device
+# locking: backend bootstrap (platform selection, device enumeration)
+# is process-global and racy, so the FIRST device touch runs exactly
+# once under this module lock; every later device call relies on JAX's
+# own thread safety plus the scheduler's per-device locks.
+_first_touch_lock = threading.Lock()
+_first_touch_done = False
+
+
+def _ensure_jax_ready() -> None:
+    global _first_touch_done
+    if _first_touch_done:
+        return
+    with _first_touch_lock:
+        if _first_touch_done:
+            return
+        import jax
+        jax.devices()
+        _first_touch_done = True
 
 
 class _HostDoc:
@@ -61,7 +106,11 @@ class SessionBank:
     def __init__(self, shard_id: int, max_sessions: int = 8,
                  max_slots: int = 1 << 24, engine: str = "device",
                  device=None, metrics: Optional[ServeMetrics] = None,
-                 session_opts: Optional[dict] = None) -> None:
+                 session_opts: Optional[dict] = None,
+                 fused: bool = False,
+                 fused_opts: Optional[dict] = None,
+                 warmup: bool = False,
+                 flush_docs: int = 8) -> None:
         if engine not in ("device", "host"):
             raise ValueError(f"unknown engine {engine!r}")
         self.shard_id = shard_id
@@ -71,11 +120,43 @@ class SessionBank:
         self.device = device
         self.metrics = metrics
         self.session_opts = dict(session_opts or {})
+        # fused=True builds tpu.flush_fuse.FusedDocSessions so
+        # sync_docs can replay whole buckets in one device call;
+        # fused_opts (cap / max_ins / headroom) go to that ctor
+        self.fused = bool(fused) and engine == "device"
+        self.fused_opts = dict(fused_opts or {})
+        self.flush_docs = int(flush_docs)
         self.sessions: "OrderedDict[str, object]" = OrderedDict()
         self._resyncs_seen: Dict[str, int] = {}
         # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
         # evictions and fallbacks are rare enough to record each one
         self.recorder = None
+        self._warmup_thread: Optional[threading.Thread] = None
+        if warmup and self.fused:
+            self._warmup_thread = threading.Thread(
+                target=self._warmup, daemon=True)
+            self._warmup_thread.start()
+
+    def _warmup(self) -> None:
+        """Background jit pre-compilation for the bucket shape classes
+        this bank can flush (satellite: the first real flush should hit
+        a warm cache, not eat a compile on the request path). Compile
+        hits/misses surface through devprof's "fused" jit_cache rows."""
+        try:
+            _ensure_jax_ready()
+            from ..tpu.flush_fuse import (DEFAULT_CAP, DEFAULT_MAX_INS,
+                                          warmup_fused_cache)
+            warmup_fused_cache(
+                flush_docs=self.flush_docs,
+                cap=self.fused_opts.get("cap", DEFAULT_CAP),
+                max_ins=self.fused_opts.get("max_ins", DEFAULT_MAX_INS))
+        except Exception:   # pragma: no cover - warmup must never wedge
+            pass
+
+    def join_warmup(self, timeout: float = 30.0) -> None:
+        """Block until background warmup finishes (tests, benches)."""
+        if self._warmup_thread is not None:
+            self._warmup_thread.join(timeout=timeout)
 
     # ---- accounting ------------------------------------------------------
 
@@ -120,13 +201,19 @@ class SessionBank:
     def _build(self, doc_id: str, oplog):
         if self.engine == "host":
             return _HostDoc(oplog)
-        from ..tpu.zone_session import DeviceZoneSession
+        _ensure_jax_ready()
+        if self.fused:
+            from ..tpu.flush_fuse import FusedDocSession as cls
+            opts = self.fused_opts
+        else:
+            from ..tpu.zone_session import DeviceZoneSession as cls
+            opts = self.session_opts
         if self.device is not None:
             import jax
             with jax.default_device(self.device):
-                sess = DeviceZoneSession(oplog, **self.session_opts)
+                sess = cls(oplog, **opts)
         else:
-            sess = DeviceZoneSession(oplog, **self.session_opts)
+            sess = cls(oplog, **opts)
         # the initial build counts as this doc's baseline, not a resync
         self._resyncs_seen[doc_id] = getattr(sess, "resyncs", 0)
         return sess
@@ -173,6 +260,8 @@ class SessionBank:
             device_s = 0.0
             if self.engine == "device" and PROFILER.enabled:
                 carry = getattr(sess, "carry", None)
+                if carry is None:   # fused sessions fence on lens
+                    carry = getattr(sess, "lens", None)
                 if carry is not None:
                     td = time.perf_counter()
                     try:
@@ -205,6 +294,136 @@ class SessionBank:
                     error=f"{e.__class__.__name__}: {e}"[:120])
             return {"engine": "host", "steps": _HostDoc(oplog).sync(),
                     "error": f"{e.__class__.__name__}: {e}"[:200]}
+
+    def sync_docs(self, items, resolve,
+                  oplog_lock=None, device_lock=None) -> dict:
+        """Flush one taken bucket, fusing where possible (module
+        docstring: the fallback ladder). `items` are admission
+        PendingMerge rows; `resolve(doc_id) -> OpLog` is called OUTSIDE
+        `oplog_lock` (DocStore.get takes that same non-reentrant lock).
+
+        Lock discipline: `oplog_lock` around host-side phases (build,
+        plan, fallback bookkeeping), `device_lock` around the fused
+        device replay only — see the module docstring.
+
+        Returns {"docs", "fused_calls", "fused_docs", "fallback_docs"}.
+        """
+        import contextlib
+        olock = oplog_lock if oplog_lock is not None \
+            else contextlib.nullcontext()
+        dlock = device_lock if device_lock is not None \
+            else contextlib.nullcontext()
+        # resolve first, outside every lock (non-reentrant store lock)
+        ols = {it.doc_id: resolve(it.doc_id) for it in items}
+
+        serial = list(items)
+        groups: List[tuple] = []     # (sessions, plans, doc_ids)
+        if self.fused and self.engine == "device":
+            serial, groups = self._plan_fused(items, ols, olock)
+
+        out = {"docs": len(items), "fused_calls": 0, "fused_docs": 0,
+               "fallback_docs": 0}
+        # ---- device phase: one jitted call per fused group, under the
+        # device lock ONLY — host threads keep mutating other oplogs
+        failed: List[str] = []
+        for sessions, plans, doc_ids in groups:
+            from ..tpu.flush_fuse import fused_replay
+            t0 = time.perf_counter()
+            with dlock:
+                if self.device is not None:
+                    import jax
+                    with jax.default_device(self.device):
+                        ok, device_s = fused_replay(sessions, plans)
+                else:
+                    ok, device_s = fused_replay(sessions, plans)
+            wall = time.perf_counter() - t0
+            n = len(sessions)
+            out["fused_calls"] += 1
+            out["fused_docs"] += n
+            if self.metrics is not None:
+                self.metrics.record_fused(self.shard_id, n)
+                self.metrics.observe_device_time(self.shard_id, wall,
+                                                 device_s)
+            PROFILER.observe_fused(self.shard_id, wall, device_s, n)
+            for good, d in zip(ok, doc_ids):
+                self._bump("syncs")
+                if not good:
+                    failed.append(d)
+        # ---- host phase: per-doc fallbacks + poisoned-result cleanup
+        with olock:
+            for d in failed:
+                # poisoned (-1) or length-drift result: the session's
+                # device state is untrusted — evict it and serve the
+                # doc from the host oracle until its next rebuild
+                self.evict(d)
+                self._bump("host_fallbacks")
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "host_fallback", shard=self.shard_id, doc=d,
+                        error="fused_poisoned_or_len_mismatch")
+            for it in serial:
+                with dlock:
+                    self.sync_doc(it.doc_id, ols[it.doc_id])
+            out["fallback_docs"] = len(serial) + len(failed)
+            if self.metrics is not None:
+                self.metrics.observe_footprint(self.shard_id,
+                                               self.footprint_slots())
+        return out
+
+    def _plan_fused(self, items, ols, olock):
+        """Host-side phase of the fused flush, under `olock`: get/build
+        each doc's session, plan its tail, and group fusable sessions
+        by (cap, max_ins). Anything that can't fuse — non-fused
+        residency, overflowing tail, LRU-evicted mid-batch, a bucket
+        with <2 fusable docs — lands in the serial list."""
+        from ..tpu.flush_fuse import FusedDocSession
+        serial = []
+        fusable: List[tuple] = []    # (sess, plan, doc_id)
+        with olock:
+            planned = []
+            for it in items:
+                try:
+                    sess = self.session(it.doc_id, ols[it.doc_id])
+                except Exception:
+                    serial.append(it)   # build failure -> sync_doc's
+                    continue            # own fallback ladder
+                if not isinstance(sess, FusedDocSession):
+                    serial.append(it)
+                    continue
+                plan = sess.plan_tail()
+                if not plan.fits(sess.cap):
+                    serial.append(it)   # overflow -> per-doc resync
+                    continue
+                planned.append((it, sess, plan))
+            for it, sess, plan in planned:
+                # building session N can LRU-evict already-planned M:
+                # only still-resident sessions may commit device state
+                if self.sessions.get(it.doc_id) is not sess:
+                    serial.append(it)
+                elif plan.n_ops == 0:
+                    # frontier advance with no visible ops (e.g. a
+                    # delete of an already-deleted span): no device work
+                    sess.commit_host(plan)
+                    self._bump("syncs")
+                else:
+                    fusable.append((sess, plan, it.doc_id))
+        if len(fusable) < 2:
+            # <2 fusable docs: the per-doc path amortizes nothing, so
+            # keep the simple ladder (sync_doc replans internally)
+            serial.extend(
+                next(it for it in items if it.doc_id == d)
+                for _s, _p, d in fusable)
+            return serial, []
+        by_shape: Dict[tuple, list] = {}
+        for sess, plan, d in fusable:
+            by_shape.setdefault((sess.cap, sess.max_ins), []).append(
+                (sess, plan, d))
+        groups = [(
+            [s for s, _p, _d in grp],
+            [p for _s, p, _d in grp],
+            [d for _s, _p, d in grp],
+        ) for grp in by_shape.values()]
+        return serial, groups
 
     def text(self, doc_id: str, oplog) -> str:
         """Merged text for the doc — from the resident session when one

@@ -27,6 +27,7 @@ devices); a real mesh only changes placement, not the code path.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -121,7 +122,10 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     max_pending: int = 64, max_sessions: int = 4,
                     seed: int = 7, place_on_devices: bool = True,
                     session_opts: Optional[dict] = None,
-                    obs_sample_rate: float = 0.01) -> dict:
+                    obs_sample_rate: float = 0.01,
+                    fused: bool = True, flush_workers: bool = True,
+                    warmup: bool = False,
+                    steady_rounds: int = 0) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
     report with throughput, the metrics snapshot, the parity gate, and
     the device-profiler snapshot (wall vs. device time per flush, jit
@@ -153,16 +157,28 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     else:
         raise ValueError(f"unknown mode {mode!r}")
 
+    # enable the profiler BEFORE scheduler construction so background
+    # warmup compiles land in the "fused" jit_cache rows
+    PROFILER.reset()
+    PROFILER.enabled = True
+    # with flush workers on, worker threads READ oplogs (tail planning)
+    # while this loop APPENDS to them — the oplog lock makes that safe,
+    # exactly the way the sync server passes DocStore.lock
+    oplog_lock = threading.Lock()
     sched = MergeScheduler(
         shards, resolve=ols.__getitem__, engine=engine,
         max_sessions_per_shard=max_sessions,
         max_pending=max_pending, flush_docs=flush_docs,
         flush_deadline_s=flush_deadline_s,
-        place_on_devices=place_on_devices, session_opts=session_opts)
+        place_on_devices=place_on_devices, session_opts=session_opts,
+        sync_lock=oplog_lock, fused=fused,
+        flush_workers=flush_workers, warmup=warmup)
     obs = Observability(sample_rate=obs_sample_rate, seed=seed)
     sched.attach_obs(obs)
-    PROFILER.reset()
-    PROFILER.enabled = True
+    if warmup:
+        # the bench should measure warm-cache flushes, not count the
+        # background compile into the first flush window
+        sched.banks[0].join_warmup()
 
     t0 = time.perf_counter()
     total_ops = 0
@@ -172,7 +188,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         done = []
         for d, gen in live.items():
             try:
-                n = next(gen)
+                with oplog_lock:
+                    n = next(gen)
             except StopIteration:
                 done.append(d)
                 continue
@@ -191,7 +208,40 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
             del live[d]
         sched.pump()
     sched.drain()
+
+    # steady-state phase (lockstep): the continuous feed above runs
+    # orders of magnitude faster than the flush cadence, so workers
+    # mostly see a backlog whose ops an earlier tip-sync already
+    # consumed — realistic for a burst, but it never measures the
+    # fused path's steady-state shape. Here every doc is RESIDENT:
+    # each round appends one more txn per doc and drains, so each
+    # flush carries its whole bucket with fresh tails — the docs-per-
+    # device-call occupancy the fused flush exists to raise.
+    if steady_rounds:
+        if mode == "trace":
+            sdata = synth_trace(n_txns=steady_rounds, seed=seed + 1)
+            sfeeders = {d: f(ols[d]) for d, f in
+                        _trace_feeders(sdata, doc_ids).items()}
+        else:
+            ssched = _concurrent_schedule(steady_rounds, 2, seed + 1)
+            sfeeders = {d: f(ols[d]) for d, f in _concurrent_feeders(
+                ssched, doc_ids, seed + 1).items()}
+        for _ in range(steady_rounds):
+            for d, gen in sfeeders.items():
+                try:
+                    with oplog_lock:
+                        n = next(gen)
+                except StopIteration:
+                    continue
+                total_ops += n
+                r = sched.submit(d, n_ops=n)
+                while not r["accepted"]:
+                    retries += 1
+                    sched.pump(force=True)
+                    r = sched.submit(d, n_ops=n)
+            sched.drain()
     feed_wall = time.perf_counter() - t0
+    sched.stop_workers()
 
     mismatches = []
     for d in doc_ids:
@@ -201,13 +251,17 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
             mismatches.append(d)
     wall = time.perf_counter() - t0
 
+    m = sched.metrics_json()
     report = {
         "config": {"shards": shards, "docs": docs, "engine": engine,
                    "mode": mode, "corpus": corpus,
                    "rounds": n_rounds, "flush_docs": flush_docs,
                    "flush_deadline_s": flush_deadline_s,
                    "max_pending": max_pending,
-                   "max_sessions": max_sessions, "seed": seed},
+                   "max_sessions": max_sessions, "seed": seed,
+                   "fused": sched.fused,
+                   "flush_workers": flush_workers, "warmup": warmup,
+                   "steady_rounds": steady_rounds},
         "total_ops": total_ops,
         "submit_retries": retries,
         "feed_wall_s": round(feed_wall, 3),
@@ -215,7 +269,9 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         "ops_per_sec": round(total_ops / max(feed_wall, 1e-9)),
         "parity_ok": not mismatches,
         "parity_mismatches": mismatches,
-        "metrics": sched.metrics_json(),
+        "fused_device_calls": m["fused"]["device_calls"],
+        "fused_occupancy": m["fused"]["occupancy"],
+        "metrics": m,
         "devprof": PROFILER.snapshot(),
         "obs": {"trace": obs.tracer.stats()},
     }

@@ -7,16 +7,20 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"version": 4,                   # counter-set schema; bump on change
+  {"version": 5,                   # counter-set schema; bump on change
    "uptime_s": s,                  # monotonic since construction
    "shards": N, "flush_docs": B,
    "totals": {"submits", "coalesced", "rejects", "denied", "fenced",
               "flushes", "flushed_docs", "flushed_ops", "builds",
-              "evictions", "resyncs", "syncs", "host_fallbacks"},
+              "evictions", "resyncs", "syncs", "host_fallbacks",
+              "fused_calls", "fused_docs"},
    "batch_occupancy": mean(flush size) / flush_docs,   # 0..1
    "host_fallback_ratio": host_fallbacks / max(syncs, 1),
    "flush_reasons": {"size": n, "deadline": n, "force": n},
    "flush_size_hist": {"1": n, "2": n, ...},
+   "fused": {"device_calls", "docs",          # fused bucket replays
+             "occupancy",                     # docs per device call
+             "occupancy_hist": {"2": n, ...}},
    "max_depth_seen": d,
    "queue_bound_violations": 0,     # depth observed above max_pending
    "latencies": {"flush": hist},    # obs.hist snapshot w/ p50/p90/p99
@@ -37,7 +41,8 @@ from ..obs.hist import Histogram
 
 _SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "fenced",
                "flushes", "flushed_docs", "flushed_ops", "builds",
-               "evictions", "resyncs", "syncs", "host_fallbacks")
+               "evictions", "resyncs", "syncs", "host_fallbacks",
+               "fused_calls", "fused_docs")
 
 
 class ServeMetrics:
@@ -46,8 +51,10 @@ class ServeMetrics:
     # `denied` ownership-gate counter; v3 = `fenced`, queued work
     # skipped at flush because its admit-time lease epoch is no longer
     # the one this host holds; v4 = `latencies.flush` histogram and
-    # per-shard `flush_wall_s`/`device_sync_s` device-time attribution)
-    SCHEMA_VERSION = 4
+    # per-shard `flush_wall_s`/`device_sync_s` device-time attribution;
+    # v5 = fused-flush counters (`fused_calls`/`fused_docs`) and the
+    # `fused` occupancy block — docs folded per vmapped device call)
+    SCHEMA_VERSION = 5
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -62,6 +69,7 @@ class ServeMetrics:
             {k: 0 for k in _SHARD_KEYS} for _ in range(n_shards)]
         self.flush_reasons: Dict[str, int] = {}
         self.flush_size_hist: Dict[int, int] = {}
+        self.fused_occupancy_hist: Dict[int, int] = {}
         self.max_depth_seen = 0
         self.queue_bound_violations = 0
         self.queue_depth: List[int] = [0] * n_shards
@@ -92,6 +100,17 @@ class ServeMetrics:
                 self.flush_size_hist.get(n_docs, 0) + 1
         # histogram carries its own lock; record outside ours
         self.flush_latency.record(dur_s)
+
+    def record_fused(self, shard: int, n_docs: int) -> None:
+        """One fused bucket replay: `n_docs` documents folded into a
+        single vmapped device call (the occupancy histogram is the
+        arithmetic-intensity signal the fused flush exists to raise)."""
+        with self._lock:
+            c = self.shard[shard]
+            c["fused_calls"] += 1
+            c["fused_docs"] += n_docs
+            self.fused_occupancy_hist[n_docs] = \
+                self.fused_occupancy_hist.get(n_docs, 0) + 1
 
     def observe_device_time(self, shard: int, wall_s: float,
                             device_s: float) -> None:
@@ -150,6 +169,16 @@ class ServeMetrics:
             "flush_reasons": dict(self.flush_reasons),
             "flush_size_hist": {str(k): v for k, v in
                                 sorted(self.flush_size_hist.items())},
+            "fused": {
+                "device_calls": totals["fused_calls"],
+                "docs": totals["fused_docs"],
+                "occupancy": round(
+                    totals["fused_docs"]
+                    / max(totals["fused_calls"], 1), 4),
+                "occupancy_hist": {
+                    str(k): v for k, v in
+                    sorted(self.fused_occupancy_hist.items())},
+            },
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,
             "latencies": {"flush": flush_hist},

@@ -12,10 +12,27 @@ Threading: the global `lock` guards router + queue mutation only; each
 shard's BANK has its own lock, so flushes (the device work) run with
 the global lock RELEASED and different shards flush concurrently —
 submits and reads for other shards never stall behind one shard's
-device call. Lock order is always global → shard → sync_lock, never
-reversed. Intended callers: (a) HTTP handler threads submitting and
-reading, (b) pump threads flushing (`start_pump`), and (c) bench
-drivers doing both inline.
+device call. With `flush_workers=True` (default) `pump()` only TAKES
+due buckets under the global lock and hands them to per-shard worker
+threads, so the pump caller returns immediately and shards genuinely
+overlap their flush windows; `drain()` waits for workers to go idle
+and `stop_workers()`/`stop_pump()` join them deterministically. The
+fencing recheck runs INSIDE the worker (see `_flush_items`), so lease
+epochs are validated at actual merge time, not dispatch time.
+
+The old process-global `_sync_lock` over-serialized device syncs
+across ALL shards. It is now narrowed to its real job — an OPLOG guard
+(`sync_lock`, e.g. DocStore.lock, held around host-side oplog reads so
+bank planning never races handler threads mutating the oplog) — while
+device execution is guarded by a PER-DEVICE lock (shards placed on the
+same chip share one; distinct chips flush concurrently). The one
+remaining process-global serialization point is first-touch JAX
+backend init (`bank._ensure_jax_ready`), which is not thread-safe and
+runs exactly once. Lock order is always
+global → shard → sync(oplog) → device, never reversed. Intended
+callers: (a) HTTP handler threads submitting and reading, (b) pump
+threads flushing (`start_pump`), and (c) bench drivers doing both
+inline.
 
 Ownership gate: when `admit` is set (cross-host replication — a
 `replicate.ReplicaNode.owns` bound method), `submit` consults it first
@@ -33,6 +50,7 @@ owner merges them.
 from __future__ import annotations
 
 import contextlib
+import queue as _queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -56,12 +74,23 @@ class MergeScheduler:
                  place_on_devices: bool = False,
                  session_opts: Optional[dict] = None,
                  sync_lock=None,
-                 admit: Optional[Callable[[str], bool]] = None) -> None:
+                 admit: Optional[Callable[[str], bool]] = None,
+                 fused: bool = True,
+                 fused_opts: Optional[dict] = None,
+                 flush_workers: bool = True,
+                 warmup: bool = False) -> None:
         """`resolve(doc_id) -> OpLog` is the document authority —
         DocStore.get fits directly. `sync_lock` (e.g. DocStore.lock) is
-        held around each doc's sync so bank reads never race handler
-        threads mutating the oplog; `resolve` is always called OUTSIDE
-        it (DocStore.get takes that same non-reentrant lock)."""
+        the OPLOG guard: held around host-side oplog reads (session
+        build / tail planning / host syncs) so bank reads never race
+        handler threads mutating the oplog; `resolve` is always called
+        OUTSIDE it (DocStore.get takes that same non-reentrant lock).
+        Device execution is guarded by per-device locks instead — see
+        the module docstring. `fused=True` (device engine only) builds
+        flush-fuse sessions and replays whole buckets in one vmapped
+        device call; `flush_workers=True` flushes through per-shard
+        worker threads; `warmup=True` pre-compiles the fused kernels on
+        a background thread at construction."""
         self.resolve = resolve
         self._sync_lock = sync_lock if sync_lock is not None \
             else contextlib.nullcontext()
@@ -74,12 +103,30 @@ class MergeScheduler:
         if place_on_devices and engine == "device":
             from ..parallel.mesh import serve_shard_devices
             devices = serve_shard_devices(n_shards)
+        self.fused = bool(fused) and engine == "device"
         self.banks = [
             SessionBank(i, max_sessions=max_sessions_per_shard,
                         max_slots=max_slots_per_shard, engine=engine,
                         device=devices[i], metrics=self.metrics,
-                        session_opts=session_opts)
+                        session_opts=session_opts,
+                        fused=fused, fused_opts=fused_opts,
+                        # the jit cache is process-global: one warmer
+                        # covers every shard's shape classes
+                        warmup=(warmup and i == 0),
+                        flush_docs=flush_docs)
             for i in range(n_shards)]
+        # per-DEVICE locks: shards placed on the same chip share one;
+        # unplaced shards (device=None) get their own (the default
+        # device is thread-safe — contention there is a perf matter,
+        # not a correctness one)
+        by_dev: Dict[int, threading.Lock] = {}
+        self._device_locks: List[threading.Lock] = []
+        for i, dev in enumerate(devices):
+            key = id(dev) if dev is not None else ("shard", i)
+            lock = by_dev.get(key)
+            if lock is None:
+                lock = by_dev[key] = threading.Lock()
+            self._device_locks.append(lock)
         # `admit(doc_id) -> bool` — the cross-host ownership gate
         # (replicate.ReplicaNode.owns); None = single-host, admit all
         self.admit = admit
@@ -93,6 +140,17 @@ class MergeScheduler:
         self._shard_locks = [threading.Lock() for _ in range(n_shards)]
         self._pump_stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
+        # per-shard flush workers (lazy-spawned daemons): pump() hands
+        # taken batches to these so distinct shards' flush windows
+        # genuinely overlap; _inflight + the condvar make drain()
+        # deterministic
+        self._flush_workers = bool(flush_workers)
+        self._work_qs: List[_queue.Queue] = [
+            _queue.Queue() for _ in range(n_shards)]
+        self._workers: List[Optional[threading.Thread]] = \
+            [None] * n_shards
+        self._inflight = 0
+        self._idle_cv = threading.Condition()
 
     def attach_obs(self, obs) -> None:
         """Wire an obs.Observability bundle into the admit→flush path:
@@ -163,12 +221,15 @@ class MergeScheduler:
 
     def pump(self, now: Optional[float] = None,
              force: bool = False) -> int:
-        """Flush every due bucket. Returns the number of docs synced.
+        """Flush every due bucket. Returns the number of docs
+        dispatched (synced inline, or handed to a shard worker).
 
-        Queue mutation (due/take) happens under the global lock; the
-        sync work itself runs under each shard's OWN lock with the
-        global lock released, so shards flush concurrently and submits
-        never wait on device calls (ROADMAP item (a) groundwork)."""
+        Queue mutation (due/take) happens under the global lock only;
+        the flush work runs on per-shard worker threads (or inline
+        without workers) under each shard's OWN lock, so shards flush
+        concurrently and submits never wait on device calls (ROADMAP
+        item (a)). Queue depths are re-recorded in a single pass after
+        dispatch — one lock acquisition, each touched shard once."""
         now = time.monotonic() if now is None else now
         taken = []      # (shard, reason, items)
         with self.lock:
@@ -178,14 +239,71 @@ class MergeScheduler:
                     taken.append((shard, reason, items))
         synced = 0
         for shard, reason, items in taken:
-            self._flush_items(shard, reason, items)
+            if self._flush_workers:
+                self._dispatch(shard, reason, items)
+            else:
+                self._flush_items(shard, reason, items)
             synced += len(items)
         if taken:
             with self.lock:
-                for shard, _reason, _items in taken:
+                for shard in {s for s, _r, _i in taken}:
                     self.metrics.observe_queue(
                         shard, self.queue.depth(shard))
         return synced
+
+    # ---- worker pool -----------------------------------------------------
+
+    def _dispatch(self, shard: int, reason: str, items) -> None:
+        """Hand one taken batch to its shard's worker (spawned lazily:
+        a host-engine scheduler that never pumps never pays for
+        threads)."""
+        with self._idle_cv:
+            self._inflight += 1
+        if self._workers[shard] is None:
+            t = threading.Thread(target=self._worker_loop, args=(shard,),
+                                 name=f"flush-worker-{shard}",
+                                 daemon=True)
+            self._workers[shard] = t
+            t.start()
+        self._work_qs[shard].put((reason, items))
+
+    def _worker_loop(self, shard: int) -> None:
+        q = self._work_qs[shard]
+        while True:
+            job = q.get()
+            if job is None:
+                return
+            reason, items = job
+            try:
+                self._flush_items(shard, reason, items)
+            except Exception:   # pragma: no cover - keep the shard alive
+                pass
+            finally:
+                with self._idle_cv:
+                    self._inflight -= 1
+                    self._idle_cv.notify_all()
+
+    def _wait_idle(self, timeout: float = 30.0) -> None:
+        """Block until every dispatched batch has been flushed."""
+        deadline = time.monotonic() + timeout
+        with self._idle_cv:
+            while self._inflight > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:   # pragma: no cover - defensive
+                    return
+                self._idle_cv.wait(timeout=left)
+
+    def stop_workers(self) -> None:
+        """Join the flush workers deterministically (after a drain()).
+        Safe to call repeatedly; workers respawn on the next pump."""
+        self._wait_idle()
+        for i, w in enumerate(self._workers):
+            if w is not None:
+                self._work_qs[i].put(None)
+        for i, w in enumerate(self._workers):
+            if w is not None:
+                w.join(timeout=5)
+                self._workers[i] = None
 
     def _flush_items(self, shard: int, reason: str, items) -> None:
         """Sync one taken batch into its shard's bank, under that
@@ -223,15 +341,18 @@ class MergeScheduler:
         bank = self.banks[shard]
         t0 = time.perf_counter()
         with self._shard_locks[shard]:
-            for item in items:
-                ol = self.resolve(item.doc_id)
-                dspan = NOOP_SPAN if not fspan.sampled else \
-                    obs.tracer.start("serve.device_sync",
-                                     parent=fspan.context(),
-                                     attrs={"doc": item.doc_id})
-                with self._sync_lock:
-                    bank.sync_doc(item.doc_id, ol)
-                dspan.end()
+            # one device_sync span per taken batch — the whole bucket
+            # is (at best) ONE device call now, so per-doc spans would
+            # misrepresent the execution shape
+            dspan = NOOP_SPAN if not fspan.sampled else \
+                obs.tracer.start("serve.device_sync",
+                                 parent=fspan.context(),
+                                 attrs={"docs": len(items)})
+            res = bank.sync_docs(
+                items, self.resolve, oplog_lock=self._sync_lock,
+                device_lock=self._device_locks[shard])
+            dspan.end(fused_calls=res["fused_calls"],
+                      fused_docs=res["fused_docs"])
         dur = time.perf_counter() - t0
         fspan.end(dur_s=round(dur, 6))
         self.metrics.record_flush(
@@ -240,13 +361,15 @@ class MergeScheduler:
 
     def drain(self) -> int:
         """Flush everything regardless of triggers (shutdown, rebalance,
-        parity checks)."""
+        parity checks), then wait for the shard workers to go idle —
+        the return means every dispatched doc has actually merged."""
         total = 0
         while self.queue.total_depth():
             n = self.pump(force=True)
             if n == 0:
                 break     # defensive: a take() returning nothing
             total += n
+        self._wait_idle()
         return total
 
     # ---- reads / control -------------------------------------------------
@@ -323,3 +446,4 @@ class MergeScheduler:
         self._pump_stop = threading.Event()
         if drain:
             self.drain()
+        self.stop_workers()

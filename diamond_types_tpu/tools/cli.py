@@ -246,7 +246,9 @@ def cmd_serve_bench(args) -> int:
               flush_docs=args.flush_docs,
               flush_deadline_s=args.flush_deadline,
               max_pending=args.max_pending,
-              max_sessions=args.max_sessions, seed=args.seed)
+              max_sessions=args.max_sessions, seed=args.seed,
+              fused=args.fused, flush_workers=args.workers,
+              warmup=args.warmup, steady_rounds=args.steady_rounds)
     if args.dry_run:
         # CI smoke preset: host engine, tiny workload, no jax needed
         kw.update(shards=2, docs=4, txns=6, engine="host",
@@ -262,10 +264,13 @@ def cmd_serve_bench(args) -> int:
         print(f"serve-bench: {report['config']['docs']} docs / "
               f"{report['config']['shards']} shards "
               f"({report['config']['engine']} engine, "
-              f"{report['config']['mode']} mode): "
+              f"{report['config']['mode']} mode, "
+              f"fused={'on' if report['config'].get('fused') else 'off'}): "
               f"{report['total_ops']} ops in {report['wall_s']}s "
               f"({report['ops_per_sec']} ops/s), "
               f"occupancy {m['batch_occupancy']}, "
+              f"fused calls {report['fused_device_calls']} "
+              f"@ {report['fused_occupancy']} docs/call, "
               f"parity {'OK' if report['parity_ok'] else 'MISMATCH'}")
     return 0 if report["parity_ok"] else 1
 
@@ -447,6 +452,26 @@ def main(argv=None) -> int:
     c.add_argument("--max-pending", type=int, default=64)
     c.add_argument("--max-sessions", type=int, default=4)
     c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--fused", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="fused vmapped bucket flush (--no-fused = the "
+                   "serial per-doc zone-session path, for speedup "
+                   "comparisons)")
+    c.add_argument("--workers", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="per-shard flush worker threads "
+                   "(--no-workers = inline serial pump)")
+    c.add_argument("--warmup", action="store_true",
+                   help="pre-compile the fused jit kernels before "
+                   "feeding (keeps compiles off the flush path)")
+    c.add_argument("--steady-rounds", type=int, default=0,
+                   help="extra lockstep rounds against resident "
+                   "sessions after the continuous feed — the fused "
+                   "occupancy measurement (see serve/driver.py)")
+    c.add_argument("--parity", action="store_true",
+                   help="explicit parity gate (parity is always "
+                   "checked; this just documents the intent in CI "
+                   "invocations)")
     c.add_argument("--json", action="store_true",
                    help="print the full JSON report")
     c.add_argument("--metrics-out", help="write the JSON report here")

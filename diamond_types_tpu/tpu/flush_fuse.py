@@ -1,0 +1,365 @@
+"""Fused vmapped bucket flush: many documents, ONE device call.
+
+The serve tier batches work (shape-bucketed admission queues) but the
+pre-fusion flush threw the batch away at the device boundary: each doc
+in a taken bucket was synced back-to-back, so batch occupancy bought
+compile reuse but zero arithmetic intensity (ROADMAP item (c)). This
+module closes that gap with the `tpu/batch.py replay_batch` shape —
+`lax.scan` over op index, batched over documents — continued from
+RESIDENT device state instead of replayed from scratch:
+
+  * `FusedDocSession` — a document resident on the device as a dense
+    `[cap]` char-code buffer + length (the replay-kernel state). The
+    pending op tail since the last sync is extracted HOST-side through
+    the oplog's transformed-op stream (`get_xf_operations_full`, the
+    same oracle every host engine applies), so concurrent/merged
+    histories arrive as plain positional ops — the device only ever
+    sees the bounded-shift linear form.
+  * `plan_tail()` packs that tail into dense `(pos, dlen, ilen, chars)`
+    rows, splitting long ops to `max_ins` exactly like
+    `encode_trace_ops` (the bounded-shift contract that keeps the tail
+    shift a static-roll select, see batch.py).
+  * `fused_replay(sessions, plans)` stacks every doc in the bucket into
+    `[b, n, max_ins]` arrays — `n` padded to the bucket's power-of-two
+    shape class, `b` rounded to a power of two so the jit cache stays
+    O(log^2) — and runs ONE jitted scan for the whole bucket.
+
+Contract violations (an op longer than `max_ins` reaching the kernel)
+poison that DOCUMENT's length to -1 — per-doc, not per-batch, so one
+bad doc falls back to the host engine without discarding its bucket
+neighbors' work. `fused_replay` additionally cross-checks each
+returned length against the host-side projection; any drift evicts the
+session and the bank serves the doc from `oplog.checkout_tip()`.
+
+Everything device-touching imports jax lazily: the serve tier's host
+engine (the HTTP server default) must never pull in a backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .merge_kernel import _pow2
+
+DEFAULT_CAP = 1 << 10
+DEFAULT_MAX_INS = 16
+# shape classes the background warmer compiles ahead of the first real
+# flush (ops-per-doc axis); batch classes derive from flush_docs
+WARMUP_SHAPE_CLASSES = (1, 2, 4, 8)
+
+_fused_jit_cache = {}
+_fused_jit_lock = threading.Lock()
+
+
+def _fused_fn(b: int, n: int, mi: int, cap: int):
+    """Jitted fused-tail replay for batch `b`, `n` ops/doc, `max_ins`
+    `mi`, capacity `cap` — all static, all powers of two, so the cache
+    holds O(log^2) entries no matter how buckets drift."""
+    import jax
+
+    key = (b, n, mi, cap)
+    with _fused_jit_lock:
+        fn = _fused_jit_cache.get(key)
+        from ..obs.devprof import note_jit_lookup
+        note_jit_lookup("fused", fn is not None)
+        if fn is not None:
+            return fn
+        import jax.numpy as jnp
+
+        from .batch import _apply_ops_batched
+
+        def run(docs, lens, pos, dlen, ilen, chars):
+            # bounded-shift contract check, PER DOC: a violating op is
+            # zeroed to a no-op and only its own doc's length is
+            # poisoned to -1 — bucket neighbors keep their result
+            bad = (dlen > mi) | (ilen > mi)
+            dlen = jnp.where(bad, 0, dlen)
+            ilen = jnp.where(bad, 0, ilen)
+            bad_doc = jnp.any(bad, axis=1)
+
+            def step(carry, op):
+                d, l, p, dl, il, c = carry + op
+                d, l = _apply_ops_batched(d, l, p, dl, il, c)
+                return (d, l), None
+
+            ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
+                   jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
+            (docs, lens), _ = jax.lax.scan(step, (docs, lens), ops)
+            return docs, jnp.where(bad_doc, -1, lens)
+
+        fn = jax.jit(run, donate_argnums=(0, 1))
+        _fused_jit_cache[key] = fn
+        return fn
+
+
+def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
+                       max_ins: int = DEFAULT_MAX_INS,
+                       shape_classes: Sequence[int] = WARMUP_SHAPE_CLASSES
+                       ) -> int:
+    """Compile the fused kernel for every (batch, ops) shape class a
+    bank configured with `flush_docs` can emit, so the first REAL flush
+    hits a warm jit cache instead of eating a compile on the request
+    path. Returns the number of kernels compiled. Hits/misses surface
+    through the existing `devprof.jit_cache` fields (cache "fused")."""
+    import jax.numpy as jnp
+
+    compiled = 0
+    batches = sorted({1} | {_pow2(k) for k in range(2, flush_docs + 1)})
+    for b in batches:
+        for ncls in shape_classes:
+            n = _pow2(ncls)
+            fn = _fused_fn(b, n, max_ins, cap)
+            docs = jnp.zeros((b, cap), jnp.int32)
+            lens = jnp.zeros((b,), jnp.int32)
+            z = jnp.zeros((b, n), jnp.int32)
+            ch = jnp.zeros((b, n, max_ins), jnp.int32)
+            out_docs, out_lens = fn(docs, lens, z, z, z, ch)
+            import jax
+            jax.block_until_ready(out_lens)
+            compiled += 1
+    return compiled
+
+
+@dataclass
+class TailPlan:
+    """Host-side packing of one doc's pending op tail (see
+    FusedDocSession.plan_tail). `max_len` past the session cap means
+    the plan does not fit — the caller resyncs at a larger capacity."""
+    pos: np.ndarray
+    dlen: np.ndarray
+    ilen: np.ndarray
+    chars: np.ndarray          # [n_ops, max_ins] int32
+    n_ops: int
+    new_len: int               # projected doc length after the tail
+    max_len: int               # peak length the tail passes through
+    frontier: Tuple[int, ...]  # oplog frontier after the tail
+    synced_to: int             # oplog length the plan covers
+
+    def fits(self, cap: int) -> bool:
+        return self.max_len <= cap
+
+
+def _empty_plan(frontier, synced_to, doc_len, mi) -> TailPlan:
+    z = np.zeros(0, np.int32)
+    return TailPlan(z, z, z, np.zeros((0, mi), np.int32), 0, doc_len,
+                    doc_len, frontier, synced_to)
+
+
+class FusedDocSession:
+    """A live document resident on the device as the replay-kernel
+    state: `[cap]` char codes + length. Drop-in for the bank's session
+    surface (sync / text / footprint_slots / resyncs / synced_to)."""
+
+    def __init__(self, oplog, cap: int = DEFAULT_CAP,
+                 max_ins: int = DEFAULT_MAX_INS,
+                 headroom: float = 2.0) -> None:
+        self.oplog = oplog
+        self.max_ins = int(max_ins)
+        self.headroom = float(headroom)
+        self.resyncs = -1          # the first build counts up to 0
+        self.merges = 0
+        self._materialize(min_cap=cap)
+
+    # ---- full (re)build --------------------------------------------------
+
+    def _materialize(self, min_cap: int = 0) -> None:
+        """Host checkout -> device buffer. Always correct (the host
+        tracker is the oracle); costs one full upload, so it only runs
+        at build time and on capacity growth."""
+        import jax.numpy as jnp
+
+        text = self.oplog.checkout_tip().snapshot()
+        cap = _pow2(max(int(len(text) * self.headroom), min_cap, 256))
+        buf = np.zeros(cap, np.int32)
+        if text:
+            buf[:len(text)] = np.frombuffer(
+                text.encode("utf-32-le"), dtype=np.int32)
+        self.cap = cap
+        self.docs = jnp.asarray(buf)
+        self.lens = jnp.asarray(np.int32(len(text)))
+        self.doc_len = len(text)
+        self.frontier = tuple(int(x) for x in self.oplog.version)
+        self.synced_to = len(self.oplog)
+        self.resyncs += 1
+        from ..obs.devprof import note_transfer
+        note_transfer(buf.nbytes)
+
+    # ---- host-side planning ----------------------------------------------
+
+    def plan_tail(self) -> TailPlan:
+        """Pack every op appended since the last sync into dense
+        positional rows. Pure read — commit() applies the bookkeeping,
+        so a plan can be dropped (fallback, eviction) at zero cost.
+        Concurrent/merged histories come back pre-transformed by the
+        host oracle; `pos is None` rows (deletes that already
+        happened) are no-ops and are skipped."""
+        ol = self.oplog
+        if self.synced_to >= len(ol):
+            return _empty_plan(self.frontier, self.synced_to,
+                               self.doc_len, self.max_ins)
+        mi = self.max_ins
+        xf = ol.get_xf_operations_full(list(self.frontier), ol.version)
+        rows: List[Tuple[int, int, int, str]] = []
+        cur = self.doc_len
+        peak = cur
+        from ..text.op import INS
+        for _lv, op, pos in xf:
+            if pos is None:
+                continue
+            if op.kind == INS:
+                content = ol.ops.get_run_content(op)
+                if not op.fwd:
+                    content = content[::-1]
+                off = 0
+                while off < len(content):
+                    chunk = content[off:off + mi]
+                    rows.append((pos + off, 0, len(chunk), chunk))
+                    off += len(chunk)
+                cur += len(content)
+                peak = max(peak, cur)
+            else:
+                d = len(op)
+                while d:
+                    k = min(d, mi)
+                    rows.append((pos, k, 0, ""))
+                    d -= k
+                cur -= len(op)
+        k = len(rows)
+        frontier = tuple(int(x) for x in xf.next_frontier)
+        if k == 0:
+            plan = _empty_plan(frontier, len(ol), self.doc_len, mi)
+            plan.max_len = peak
+            return plan
+        pos_a = np.zeros(k, np.int32)
+        dl_a = np.zeros(k, np.int32)
+        il_a = np.zeros(k, np.int32)
+        ch_a = np.zeros((k, mi), np.int32)
+        for i, (p, d, il, s) in enumerate(rows):
+            pos_a[i] = p
+            dl_a[i] = d
+            il_a[i] = il
+            if s:
+                ch_a[i, :il] = np.frombuffer(
+                    s.encode("utf-32-le"), dtype=np.int32)
+        return TailPlan(pos_a, dl_a, il_a, ch_a, k, cur, peak, frontier,
+                        len(ol))
+
+    def commit(self, docs, lens, plan: TailPlan) -> None:
+        """Adopt one fused-replay result row + the plan's bookkeeping."""
+        self.docs = docs
+        self.lens = lens
+        self.doc_len = plan.new_len
+        self.frontier = plan.frontier
+        self.synced_to = plan.synced_to
+        if plan.n_ops:
+            self.merges += 1
+
+    def commit_host(self, plan: TailPlan) -> None:
+        """Adopt an EMPTY plan (frontier advanced, no visible ops —
+        e.g. deletes of already-deleted spans): no device work."""
+        assert plan.n_ops == 0
+        self.frontier = plan.frontier
+        self.synced_to = plan.synced_to
+
+    # ---- merge path ------------------------------------------------------
+
+    def sync(self) -> int:
+        """Per-doc path (the fused fallback ladder's last device rung):
+        plan, then replay this doc alone at batch size 1. Resyncs on
+        capacity overflow. Raises on a poisoned result (the bank's
+        sync_doc catches, evicts and serves from the host engine)."""
+        plan = self.plan_tail()
+        if not plan.fits(self.cap):
+            self._materialize(
+                min_cap=_pow2(int(plan.max_len * self.headroom)))
+            return 0
+        if plan.n_ops == 0:
+            self.commit_host(plan)
+            return 0
+        ok, _device_s = fused_replay([self], [plan])
+        if not ok[0]:
+            raise RuntimeError(
+                "fused replay poisoned/mismatched length "
+                f"(doc_len {self.doc_len}, plan {plan.new_len})")
+        return plan.n_ops
+
+    # ---- reads -----------------------------------------------------------
+
+    def text(self) -> str:
+        """Fetch and decode the merged document (device parity
+        surface: the answer comes from the replay kernel's state, not
+        the host tracker)."""
+        n = self.doc_len
+        return np.asarray(self.docs[:n]).astype(np.int32).tobytes() \
+            .decode("utf-32-le")
+
+    def touch(self):
+        return np.asarray(self.lens)
+
+    def footprint_slots(self) -> int:
+        """Device residency in int32 slots: the doc buffer dominates."""
+        return int(self.cap)
+
+
+def fused_replay(sessions: List[FusedDocSession],
+                 plans: List[TailPlan]
+                 ) -> Tuple[List[bool], float]:
+    """Replay every session's pending tail in ONE jitted device call.
+
+    All sessions must share (cap, max_ins) — the bank groups by
+    capacity before calling. Ops pad to the max power-of-two op count
+    in the batch (the bucket's shape class) and the batch rounds up to
+    a power of two with no-op lanes, so the jit cache stays small.
+
+    Returns (ok-per-session, device_wait_s). The device wait is the
+    time spent blocked fetching the output lengths — the completion
+    fence — which is the `block_until_ready`-equivalent attribution
+    devprof wants. A session whose returned length is poisoned (-1) or
+    disagrees with the host-side projection is NOT committed — the
+    caller evicts it and serves the doc from the host engine.
+    Successful sessions have their result rows committed."""
+    import jax.numpy as jnp
+
+    b = len(sessions)
+    assert b == len(plans) and b >= 1
+    cap = sessions[0].cap
+    mi = sessions[0].max_ins
+    n = _pow2(max(max(p.n_ops for p in plans), 1))
+    bp = _pow2(b) if b > 1 else 1
+    pos = np.zeros((bp, n), np.int32)
+    dlen = np.zeros((bp, n), np.int32)
+    ilen = np.zeros((bp, n), np.int32)
+    chars = np.zeros((bp, n, mi), np.int32)
+    for i, p in enumerate(plans):
+        k = p.n_ops
+        pos[i, :k] = p.pos
+        dlen[i, :k] = p.dlen
+        ilen[i, :k] = p.ilen
+        chars[i, :k] = p.chars
+    from ..obs.devprof import note_transfer
+    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes)
+    docs = jnp.stack([s.docs for s in sessions]
+                     + [sessions[0].docs] * (bp - b))
+    lens = jnp.stack([s.lens for s in sessions]
+                     + [sessions[0].lens] * (bp - b))
+    fn = _fused_fn(bp, n, mi, cap)
+    out_docs, out_lens = fn(docs, lens, jnp.asarray(pos),
+                            jnp.asarray(dlen), jnp.asarray(ilen),
+                            jnp.asarray(chars))
+    # the length fetch is the completion fence AND the parity
+    # cross-check: poison (-1) or host-projection drift fails the doc
+    t_fence = time.perf_counter()
+    got = np.asarray(out_lens)
+    device_s = time.perf_counter() - t_fence
+    ok: List[bool] = []
+    for i, (sess, plan) in enumerate(zip(sessions, plans)):
+        good = int(got[i]) == plan.new_len and int(got[i]) >= 0
+        if good:
+            sess.commit(out_docs[i], out_lens[i], plan)
+        ok.append(good)
+    return ok, device_s

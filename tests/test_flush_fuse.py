@@ -1,0 +1,408 @@
+"""Fused vmapped bucket flush (tpu/flush_fuse.py + serve/ wiring).
+
+Covers the ISSUE-5 tentpole surface: kernel-level parity of the fused
+replay against the host oracle on randomized mixed-size buckets, the
+poisoned-length (-1) contract propagating through `sync_docs` into an
+evict + host fallback, the per-shard flush worker pool genuinely
+overlapping flush windows across shards (no process-global sync-lock
+serialization), and the fencing recheck still running INSIDE the
+worker. CPU-simulated devices via conftest's virtual 8-device mesh.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from diamond_types_tpu.serve.admission import PendingMerge
+from diamond_types_tpu.serve.bank import SessionBank
+from diamond_types_tpu.serve.metrics import ServeMetrics
+from diamond_types_tpu.serve.scheduler import MergeScheduler
+from diamond_types_tpu.text.oplog import OpLog
+from diamond_types_tpu.tpu import flush_fuse as ff
+
+pytestmark = [pytest.mark.fused, pytest.mark.serve]
+
+FUSED_OPTS = {"cap": 256, "max_ins": 4}
+
+
+def _mk_oplog(doc_id: str) -> OpLog:
+    ol = OpLog()
+    ol.doc_id = doc_id
+    return ol
+
+
+def _random_edits(ol: OpLog, rng: random.Random, n: int,
+                  agent: str = "a") -> None:
+    """Mixed-size edits, including ops longer than max_ins (forcing the
+    planner's chunk split) and deletes."""
+    a = ol.get_or_create_agent_id(agent)
+    for _ in range(n):
+        cur = len(ol.checkout_tip().snapshot())
+        if cur and rng.random() < 0.3:
+            pos = rng.randrange(cur)
+            end = min(pos + rng.randint(1, 9), cur)
+            ol.add_delete_without_content(a, pos, end)
+        else:
+            pos = rng.randint(0, cur)
+            s = "".join(rng.choice("abcdefgh") for _ in
+                        range(rng.randint(1, 11)))
+            ol.add_insert(a, pos, s)
+
+
+def _items(doc_ids):
+    return [PendingMerge(d, 1, 0.0) for d in doc_ids]
+
+
+# ---- kernel-level parity -------------------------------------------------
+
+def test_fused_replay_parity_randomized_mixed_buckets():
+    """Fused whole-bucket replay == host checkout on randomized
+    mixed-size docs, including concurrent two-agent histories."""
+    rng = random.Random(11)
+    ols = [_mk_oplog(f"d{i}") for i in range(5)]
+    for i, ol in enumerate(ols):
+        _random_edits(ol, rng, 2 + i)
+    sess = [ff.FusedDocSession(ol, **FUSED_OPTS) for ol in ols]
+    for rnd in range(3):
+        for i, ol in enumerate(ols):
+            _random_edits(ol, rng, 1 + (i + rnd) % 3)
+            if rnd == 1:
+                # a concurrent branch from an old frontier — lands as
+                # host-transformed positional ops
+                b = ol.get_or_create_agent_id("b")
+                ol.add_insert_at(b, [], 0, "Z" * (i + 1))
+        plans = [s.plan_tail() for s in sess]
+        fits = [p.fits(s.cap) for p, s in zip(plans, sess)]
+        assert all(fits)
+        ok, _dev = ff.fused_replay(sess, plans)
+        assert all(ok)
+        for s, ol in zip(sess, ols):
+            assert s.text() == ol.checkout_tip().snapshot()
+
+
+def test_fused_fn_per_doc_poison():
+    """A bounded-shift contract violation poisons only ITS doc's
+    length; bucket neighbors keep a valid result."""
+    import jax.numpy as jnp
+    import numpy as np
+    fn = ff._fused_fn(2, 1, 2, 8)
+    docs = jnp.zeros((2, 8), jnp.int32)
+    lens = jnp.zeros((2,), jnp.int32)
+    pos = jnp.zeros((2, 1), jnp.int32)
+    dlen = jnp.zeros((2, 1), jnp.int32)
+    # doc 0 violates (ilen 3 > max_ins 2); doc 1 inserts legally
+    ilen = jnp.asarray([[3], [2]], jnp.int32)
+    chars = jnp.full((2, 1, 2), ord("x"), jnp.int32)
+    _out, out_lens = fn(docs, lens, pos, dlen, ilen, chars)
+    got = np.asarray(out_lens)
+    assert got[0] == -1 and got[1] == 2
+
+
+def test_capacity_overflow_resyncs_then_converges():
+    ol = _mk_oplog("grow")
+    a = ol.get_or_create_agent_id("a")
+    ol.add_insert(a, 0, "seed")
+    sess = ff.FusedDocSession(ol, **FUSED_OPTS)
+    r0 = sess.resyncs
+    ol.add_insert(a, 0, "y" * 600)     # tail overflows cap=256
+    sess.sync()
+    sess.sync()
+    assert sess.resyncs == r0 + 1
+    assert sess.text() == ol.checkout_tip().snapshot()
+
+
+# ---- bank-level: fused vs per-doc vs host --------------------------------
+
+def test_sync_docs_three_engine_parity():
+    """The same randomized bucket through fused, per-doc zone-session,
+    and host banks — all three parity with the oplog authority."""
+    rng = random.Random(23)
+    docs = [f"p{i}" for i in range(4)]
+
+    def run(engine, fused):
+        ols = {d: _mk_oplog(d) for d in docs}
+        # fresh rng per engine so all three see identical histories
+        r = random.Random(77)
+        for d in docs:
+            _random_edits(ols[d], r, 3)
+        bank = SessionBank(0, engine=engine, fused=fused,
+                           fused_opts=FUSED_OPTS,
+                           metrics=ServeMetrics(1, 4, 64))
+        bank.sync_docs(_items(docs), ols.__getitem__)
+        for d in docs:
+            _random_edits(ols[d], r, 2)
+        res = bank.sync_docs(_items(docs), ols.__getitem__)
+        return {d: bank.text(d, ols[d]) for d in docs}, ols, res, bank
+
+    fused_txt, fols, fres, fbank = run("device", True)
+    perdoc_txt, pols, _pres, _ = run("device", False)
+    host_txt, hols, _hres, _ = run("host", False)
+    for d in docs:
+        want = fols[d].checkout_tip().snapshot()
+        assert fused_txt[d] == want
+        assert perdoc_txt[d] == pols[d].checkout_tip().snapshot()
+        assert host_txt[d] == hols[d].checkout_tip().snapshot()
+        # identical seeds -> identical content across engines
+        assert fused_txt[d] == perdoc_txt[d] == host_txt[d]
+    # the second flush had 4 resident sessions with fresh tails: the
+    # fused path must actually have fired, in ONE device call
+    assert fres["fused_calls"] == 1 and fres["fused_docs"] == 4
+    m = fbank.metrics.snapshot()
+    assert m["fused"]["device_calls"] >= 1
+    assert m["fused"]["occupancy"] > 1
+
+
+def test_sync_docs_mixed_residency_falls_back_per_doc():
+    """A non-fused session already resident in the bucket must not
+    break the flush: it goes per-doc, the rest still parity."""
+    from diamond_types_tpu.tpu.zone_session import DeviceZoneSession
+    docs = ["m0", "m1", "m2"]
+    ols = {d: _mk_oplog(d) for d in docs}
+    rng = random.Random(5)
+    for d in docs:
+        _random_edits(ols[d], rng, 3)
+    bank = SessionBank(0, engine="device", fused=True,
+                       fused_opts=FUSED_OPTS,
+                       metrics=ServeMetrics(1, 4, 64))
+    # pre-plant a legacy per-doc session for m0
+    bank.sessions["m0"] = DeviceZoneSession(ols["m0"])
+    bank._resyncs_seen["m0"] = 0
+    bank.sync_docs(_items(docs), ols.__getitem__)
+    for d in docs:
+        _random_edits(ols[d], rng, 2)
+    res = bank.sync_docs(_items(docs), ols.__getitem__)
+    assert res["fallback_docs"] >= 1     # m0 went per-doc
+    for d in docs:
+        assert bank.text(d, ols[d]) == \
+            ols[d].checkout_tip().snapshot()
+
+
+def test_poisoned_lens_propagates_to_host_fallback(monkeypatch):
+    """A fused result whose length comes back poisoned/mismatched must
+    evict the session and serve the doc from the host engine — the
+    `lens == -1` contract propagating through sync_docs."""
+    docs = ["x0", "x1"]
+    ols = {d: _mk_oplog(d) for d in docs}
+    rng = random.Random(9)
+    for d in docs:
+        _random_edits(ols[d], rng, 3)
+    metrics = ServeMetrics(1, 4, 64)
+    bank = SessionBank(0, engine="device", fused=True,
+                       fused_opts=FUSED_OPTS, metrics=metrics)
+    bank.sync_docs(_items(docs), ols.__getitem__)   # builds
+    for d in docs:
+        _random_edits(ols[d], rng, 2)
+
+    real_plan = ff.FusedDocSession.plan_tail
+
+    def bad_plan(self):
+        plan = real_plan(self)
+        if self.oplog.doc_id == "x0" and plan.n_ops:
+            # a delete longer than max_ins reaching the kernel: the
+            # device poisons this doc's length to -1
+            plan.dlen[0] = self.max_ins + 1
+        return plan
+
+    monkeypatch.setattr(ff.FusedDocSession, "plan_tail", bad_plan)
+    res = bank.sync_docs(_items(docs), ols.__getitem__)
+    monkeypatch.undo()
+    assert res["fused_calls"] == 1
+    assert "x0" not in bank.sessions          # evicted
+    snap = metrics.snapshot()
+    assert snap["totals"]["host_fallbacks"] == 1
+    # both docs still serve correct bytes (x0 from the host oracle)
+    for d in docs:
+        assert bank.text(d, ols[d]) == \
+            ols[d].checkout_tip().snapshot()
+
+
+# ---- scheduler-level: workers, concurrency, fencing ----------------------
+
+def _two_shard_docs(sched, n=2):
+    """Doc ids rendezvous-routed to shards 0 and 1, n per shard."""
+    by_shard = {0: [], 1: []}
+    i = 0
+    while any(len(v) < n for v in by_shard.values()):
+        d = f"w{i:03d}"
+        s = sched.router.shard_of(d)
+        if s in by_shard and len(by_shard[s]) < n:
+            by_shard[s].append(d)
+        i += 1
+        assert i < 4096
+    return by_shard
+
+
+def test_two_shard_concurrent_flush_windows():
+    """The worker pool + per-device locks must let two shards' flush
+    windows OVERLAP: each shard's worker blocks on a shared barrier
+    inside sync_docs, which only releases when both are inside their
+    flush simultaneously. A process-global sync lock (the pre-fusion
+    design) would deadlock the barrier."""
+    ols = {}
+    sched = MergeScheduler(2, resolve=lambda d: ols[d],
+                           engine="device", fused=True,
+                           fused_opts=FUSED_OPTS,
+                           flush_docs=2, flush_deadline_s=10.0,
+                           flush_workers=True)
+    by_shard = _two_shard_docs(sched)
+    rng = random.Random(3)
+    for shard_docs in by_shard.values():
+        for d in shard_docs:
+            ols[d] = _mk_oplog(d)
+            _random_edits(ols[d], rng, 2)
+
+    barrier = threading.Barrier(2, timeout=10)
+    overlapped = []
+    orig = SessionBank.sync_docs
+
+    def synced_sync_docs(self, items, resolve, **kw):
+        try:
+            barrier.wait()
+            overlapped.append(self.shard_id)
+        except threading.BrokenBarrierError:   # pragma: no cover
+            pass
+        return orig(self, items, resolve, **kw)
+
+    SessionBank.sync_docs = synced_sync_docs
+    try:
+        for shard_docs in by_shard.values():
+            for d in shard_docs:
+                assert sched.submit(d, n_ops=1)["accepted"]
+        sched.pump(force=True)
+        sched.drain()
+    finally:
+        SessionBank.sync_docs = orig
+        sched.stop_workers()
+    assert sorted(overlapped) == [0, 1], overlapped
+    assert not barrier.broken
+    for d, ol in ols.items():
+        assert sched.text(d) == ol.checkout_tip().snapshot()
+
+
+def test_fencing_recheck_runs_inside_worker():
+    """Work admitted under a lease epoch the host no longer holds must
+    be dropped BY THE WORKER at flush time, not merged."""
+    ols = {}
+    sched = MergeScheduler(1, resolve=lambda d: ols[d],
+                           engine="device", fused=True,
+                           fused_opts=FUSED_OPTS,
+                           flush_docs=8, flush_deadline_s=10.0,
+                           flush_workers=True)
+    epoch = {"n": 1}
+    sched.epoch_of = lambda d: epoch["n"]
+    d = "fenced-doc"
+    ols[d] = _mk_oplog(d)
+    a = ols[d].get_or_create_agent_id("a")
+    ols[d].add_insert(a, 0, "hello")
+    assert sched.submit(d, n_ops=1)["accepted"]
+    epoch["n"] = 2        # the lease moved between admit and flush
+    sched.pump(force=True)
+    sched.drain()
+    sched.stop_workers()
+    m = sched.metrics_json()
+    assert m["totals"]["fenced"] == 1
+    assert m["totals"]["syncs"] == 0      # never merged
+    assert d not in sched.banks[0].sessions
+
+
+def test_scheduler_fused_end_to_end_counters():
+    """Two pump rounds through one shard: round 1 builds, round 2 must
+    fold the whole bucket into one fused device call, with the
+    occupancy histogram and devprof attribution populated."""
+    from diamond_types_tpu.obs.devprof import PROFILER
+    ols = {}
+    sched = MergeScheduler(1, resolve=lambda d: ols[d],
+                           engine="device", fused=True,
+                           fused_opts=FUSED_OPTS,
+                           flush_docs=8, flush_deadline_s=10.0,
+                           flush_workers=False)
+    docs = [f"e{i}" for i in range(3)]
+    rng = random.Random(1)
+    PROFILER.reset()
+    PROFILER.enabled = True
+    try:
+        for rnd in range(2):
+            for d in docs:
+                if rnd == 0:
+                    ols[d] = _mk_oplog(d)
+                _random_edits(ols[d], rng, 2)
+                assert sched.submit(d, n_ops=1)["accepted"]
+            sched.pump(force=True)
+        m = sched.metrics_json()
+        assert m["version"] == 5
+        assert m["fused"]["device_calls"] >= 1
+        assert m["fused"]["occupancy"] > 1
+        assert m["fused"]["occupancy_hist"]
+        dp = PROFILER.snapshot()
+        assert dp["fused"]["device_calls"] == \
+            m["fused"]["device_calls"]
+        assert dp["fused"]["docs"] == m["fused"]["docs"]
+        assert "fused" in dp["jit_cache"]
+    finally:
+        PROFILER.enabled = False
+    for d in docs:
+        assert sched.text(d) == ols[d].checkout_tip().snapshot()
+
+
+# ---- warmup + jit cache --------------------------------------------------
+
+def test_warmup_populates_fused_jit_cache():
+    from diamond_types_tpu.obs.devprof import PROFILER
+    PROFILER.reset()
+    PROFILER.enabled = True
+    try:
+        # tiny dedicated shape class so this test owns its cache keys
+        n = ff.warmup_fused_cache(flush_docs=2, cap=64, max_ins=2,
+                                  shape_classes=(1,))
+        assert n == 2        # batches {1, 2} x one op class
+        snap1 = PROFILER.snapshot()["jit_cache"]["fused"]
+        # a second warmup over the same shapes is all hits
+        ff.warmup_fused_cache(flush_docs=2, cap=64, max_ins=2,
+                              shape_classes=(1,))
+        snap2 = PROFILER.snapshot()["jit_cache"]["fused"]
+        assert snap2["hits"] >= snap1["hits"] + 2
+        assert snap2["misses"] == snap1["misses"]
+    finally:
+        PROFILER.enabled = False
+
+
+def test_bank_background_warmup_thread_joins():
+    bank = SessionBank(0, engine="device", fused=True,
+                       fused_opts={"cap": 64, "max_ins": 2},
+                       warmup=True, flush_docs=2)
+    bank.join_warmup()
+    assert bank._warmup_thread is not None
+    assert not bank._warmup_thread.is_alive()
+
+
+# ---- prom rendering of the fused block -----------------------------------
+
+def test_prom_renders_fused_block():
+    from diamond_types_tpu.obs.prom import render_metrics
+    m = ServeMetrics(1, 4, 64)
+    m.record_fused(0, 3)
+    m.record_fused(0, 3)
+    text = render_metrics({"serve": m.snapshot()})
+    assert "dt_serve_fused_occupancy 3.0" in text
+    assert 'dt_serve_fused_flush_total{docs="3"} 2' in text
+    assert "dt_serve_fused_calls_total 2" in text
+    assert "dt_serve_fused_docs_total 6" in text
+    # one TYPE line per family, no duplicates
+    lines = [ln for ln in text.splitlines() if ln.startswith("# TYPE")]
+    assert len(lines) == len(set(lines))
+
+
+# ---- CLI flags -----------------------------------------------------------
+
+def test_cli_serve_bench_fused_flags_smoke(capsys):
+    """--fused/--no-fused, --workers/--no-workers, --warmup, --parity,
+    --steady-rounds all parse and the dry-run smoke passes parity."""
+    from diamond_types_tpu.tools.cli import main
+    rc = main(["serve-bench", "--dry-run", "--no-fused",
+               "--no-workers", "--parity", "--steady-rounds", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "parity OK" in out
+    assert "fused=off" in out
