@@ -773,7 +773,8 @@ def bench_codec(name: str):
 
 def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
                       engine: str = "device", timeout: int = 300,
-                      fused: bool = True, steady_rounds: int = 8):
+                      fused: bool = True, steady_rounds: int = 8,
+                      mesh_window: bool = False):
     """Sharded multi-document merge scheduler (serve/): replays the
     synthetic trace across `docs` docs on `shards` CPU-simulated shards
     through the router + shape-bucketed admission queue + per-shard
@@ -785,12 +786,18 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
     `fused` toggles the vmapped bucket flush (--no-fused = the serial
     per-doc zone-session path); `steady_rounds` lockstep rounds against
     resident sessions are where fused occupancy is actually measured —
-    the continuous feed races the flush workers (see serve/driver.py)."""
+    the continuous feed races the flush workers (see serve/driver.py).
+    `mesh_window` routes flushes through the mesh flush-window
+    coordinator: one shard_map dispatch per window instead of one
+    device call per shard (the report's device_calls_per_window is the
+    A/B signal)."""
     cmd = [sys.executable, "-m", "diamond_types_tpu.tools.cli",
            "serve-bench", "--shards", str(shards), "--docs", str(docs),
            "--txns", str(txns), "--engine", engine,
            "--fused" if fused else "--no-fused",
            "--steady-rounds", str(steady_rounds), "--json"]
+    if mesh_window:
+        cmd.append("--mesh-window")
     if fused:
         cmd.append("--warmup")
     p = subprocess.run(cmd, capture_output=True, text=True,
@@ -1383,6 +1390,10 @@ def _main() -> None:
             # fused bucket flush: docs folded per vmapped device call
             "fused_device_calls": sv.get("fused_device_calls"),
             "fused_occupancy": sv.get("fused_occupancy"),
+            # flush-window dispatch accounting (per-shard control:
+            # one handoff per due bucket; the mesh A/B below targets 1)
+            "device_calls_per_window":
+                sv.get("device_calls_per_window"),
         }
         # serial (per-doc zone-session) comparison on the same trace:
         # the fused-vs-serial speedup is THE number ROADMAP item (c)
@@ -1401,6 +1412,27 @@ def _main() -> None:
                     3)
         except Exception as e:  # pragma: no cover
             extra["serve_sched"]["serial_error"] = str(e)[:120]
+        # mesh flush-window comparison on the same trace: every due
+        # shard's bucket in ONE shard_map dispatch per window vs. the
+        # per-shard control above — window_speedup and the
+        # device_calls_per_window collapse are the ROADMAP item 1
+        # (true multi-chip serving) numbers
+        try:
+            svm = bench_serve_sched(mesh_window=True)
+            full["serve_sched_mesh"] = svm
+            extra["serve_sched"]["mesh_ops_per_sec"] = \
+                svm["ops_per_sec"]
+            extra["serve_sched"]["mesh_device_calls_per_window"] = \
+                svm.get("device_calls_per_window")
+            extra["serve_sched"]["mesh_parity"] = svm["parity_ok"]
+            extra["serve_sched"]["mesh_occupancy"] = \
+                svm["metrics"]["window"]["mesh_occupancy"]
+            if svm.get("feed_wall_s"):
+                extra["serve_sched"]["window_speedup"] = round(
+                    sv["feed_wall_s"] / max(svm["feed_wall_s"], 1e-9),
+                    3)
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched"]["mesh_error"] = str(e)[:120]
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 

@@ -26,6 +26,8 @@ class DeviceProfiler:
         self.transfer_bytes = 0
         self._fused = {"device_calls": 0, "docs": 0,
                        "wall_s": 0.0, "device_s": 0.0}
+        self._window = {"dispatches": 0, "docs": 0, "shards": 0,
+                        "wall_s": 0.0, "device_s": 0.0}
 
     def reset(self) -> None:
         with self._lock:
@@ -35,6 +37,8 @@ class DeviceProfiler:
             self.transfer_bytes = 0
             self._fused = {"device_calls": 0, "docs": 0,
                            "wall_s": 0.0, "device_s": 0.0}
+            self._window = {"dispatches": 0, "docs": 0, "shards": 0,
+                            "wall_s": 0.0, "device_s": 0.0}
 
     def note_jit(self, cache: str, hit: bool) -> None:
         if not self.enabled:
@@ -76,6 +80,24 @@ class DeviceProfiler:
             s["wall_s"] += wall_s
             s["device_s"] += device_s
 
+    def observe_window(self, wall_s: float, device_s: float,
+                       n_docs: int, n_shards: int) -> None:
+        """One mesh flush-window dispatch: `n_docs` docs from
+        `n_shards` shards replayed in a single shard_map program
+        (scheduler._flush_window). Kept SEPARATE from the per-shard
+        flush totals — a window is cross-shard by construction, so
+        attributing its wall time to any one shard would double-count
+        against the per_shard rows."""
+        if not self.enabled:
+            return
+        with self._lock:
+            w = self._window
+            w["dispatches"] += 1
+            w["docs"] += int(n_docs)
+            w["shards"] += int(n_shards)
+            w["wall_s"] += wall_s
+            w["device_s"] += device_s
+
     def note_transfer(self, nbytes: int) -> None:
         if not self.enabled:
             return
@@ -104,6 +126,18 @@ class DeviceProfiler:
                      "device_fraction": round(
                          f["device_s"] / f["wall_s"], 4)
                      if f["wall_s"] else 0.0}
+            w = self._window
+            nw = w["dispatches"]
+            window = {"dispatches": nw, "docs": w["docs"],
+                      "docs_per_dispatch": round(w["docs"] / nw, 4)
+                      if nw else 0.0,
+                      "mean_shards": round(w["shards"] / nw, 4)
+                      if nw else 0.0,
+                      "wall_s": round(w["wall_s"], 6),
+                      "device_sync_s": round(w["device_s"], 6),
+                      "device_fraction": round(
+                          w["device_s"] / w["wall_s"], 4)
+                      if w["wall_s"] else 0.0}
             return {"enabled": self.enabled,
                     "jit_cache": jit,
                     "flush_wall_s": round(wall, 6),
@@ -112,6 +146,7 @@ class DeviceProfiler:
                     "transfers": self.transfers,
                     "transfer_bytes": self.transfer_bytes,
                     "fused": fused,
+                    "mesh_window": window,
                     "per_shard": per_shard}
 
 

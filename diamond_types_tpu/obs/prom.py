@@ -117,6 +117,23 @@ def _render_serve(b: _Builder, serve: dict) -> None:
                               .items(), key=lambda kv: int(kv[0])):
             b.add("dt_serve_fused_flush_total", "counter", n,
                   labels={"docs": str(docs)})
+    window = serve.get("window") or {}
+    if window:
+        # the mesh flush-window block (metrics schema v6):
+        # device_calls_per_window is the N-dispatches-to-1 signal,
+        # mesh_occupancy the super-batch padding efficiency
+        for key in ("windows", "device_windows", "dispatches", "docs",
+                    "mesh_docs", "mesh_padded_rows"):
+            if key in window:
+                b.add(f"dt_serve_window_{key}_total", "counter",
+                      window[key])
+        for key in ("device_calls_per_window", "mesh_occupancy"):
+            if key in window:
+                b.add(f"dt_serve_window_{key}", "gauge", window[key])
+        for shards, n in sorted((window.get("shards_hist") or {})
+                                .items(), key=lambda kv: int(kv[0])):
+            b.add("dt_serve_window_shards_total", "counter", n,
+                  labels={"shards": str(shards)})
     for i, row in enumerate(serve.get("per_shard") or []):
         lb = {"shard": str(row.get("shard", i))}
         if "queue_depth" in row:

@@ -16,6 +16,7 @@ Everything uses jax.sharding + shard_map so XLA inserts the collectives.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Tuple
 
@@ -37,6 +38,18 @@ def make_mesh(n_devices: int | None = None, axis: str = "docs") -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def serve_mesh(n_shards: int | None = None, axis: str = "docs") -> Mesh:
+    """1-D `docs` mesh over the device slice the serve tier's shards
+    occupy (`serve_shard_devices` wraps shards onto devices; the mesh
+    covers the distinct devices actually used, capped at the shard
+    count). This is the mesh the flush-window coordinator issues its
+    single program over."""
+    devs = jax.devices()
+    n = len(devs) if n_shards is None else min(max(n_shards, 1),
+                                               len(devs))
+    return Mesh(np.array(devs[:n]), (axis,))
 
 
 def serve_shard_devices(n_shards: int):
@@ -77,6 +90,124 @@ def pad_edges(packed: dict, n_devices: int):
     plv[:m] = np.asarray(packed["edge_plv"])
     prun[:m] = np.asarray(packed["edge_prun"])
     return src, plv, prun
+
+
+def pad_batch_count(b: int, n_devices: int) -> int:
+    """Smallest super-batch size >= b that (a) divides the mesh and
+    (b) is n_devices times a power of two — divisibility is what
+    `shard_map` needs, the pow2 rounding is what keeps the mesh jit
+    cache O(log) in window size (mirroring `_pow2` batch rounding on
+    the per-shard path)."""
+    from ..tpu.merge_kernel import _pow2
+    per_dev = max(-(-max(int(b), 1) // n_devices), 1)
+    # _pow2 floors at 2; one row per device is a legal class of its own
+    # (same convention as _fused_fn's `bp = 1` for a single doc)
+    return n_devices * (1 if per_dev == 1 else _pow2(per_dev))
+
+
+def pad_batch_to_mesh(pos, dlen, ilen, chars, n_devices: int):
+    """Pad a packed super-batch's row axis to `pad_batch_count` rows
+    (mirroring `pad_edges`): padding rows carry all-zero ops — no-ops
+    through the replay kernel — and the caller pairs them with
+    `lens = -1` sentinel rows, so they stay identifiably inert end to
+    end regardless of what the window carries. Returns
+    (pos, dlen, ilen, chars, bp)."""
+    b = pos.shape[0]
+    bp = pad_batch_count(b, n_devices)
+    if bp == b:
+        return pos, dlen, ilen, chars, bp
+
+    def _pad(a):
+        out = np.zeros((bp,) + a.shape[1:], dtype=a.dtype)
+        out[:b] = a
+        return out
+
+    return _pad(pos), _pad(dlen), _pad(ilen), _pad(chars), bp
+
+
+_mesh_jit_cache = {}
+_mesh_jit_lock = threading.Lock()
+
+
+def mesh_flush_fn(mesh: Mesh, b: int, n: int, mi: int, cap: int):
+    """The mesh flush-window program: the fused replay body wrapped in
+    ONE `shard_map` over the mesh's `docs` axis, jitted with donated
+    state buffers. The body is pure data parallel (every doc's scan is
+    independent), so each device runs its `b / n_devices` row slice
+    locally and XLA inserts zero collectives — N shards' buckets flush
+    in a single dispatch. Cache keyed on (mesh, shapes), same O(log^2)
+    discipline as the per-shard `_fused_fn` cache; lookups surface as
+    devprof jit_cache "mesh" rows."""
+    key = (mesh, b, n, mi, cap)
+    with _mesh_jit_lock:
+        fn = _mesh_jit_cache.get(key)
+        from ..obs.devprof import note_jit_lookup
+        note_jit_lookup("mesh", fn is not None)
+        if fn is not None:
+            return fn
+        from ..tpu.flush_fuse import make_replay_body
+        axis = mesh.axis_names[0]
+        body = shard_map(make_replay_body(mi), mesh=mesh,
+                         in_specs=(P(axis),) * 6,
+                         out_specs=(P(axis), P(axis)))
+        fn = jax.jit(body, donate_argnums=(0, 1))
+        _mesh_jit_cache[key] = fn
+        return fn
+
+
+def mesh_fused_replay(mesh: Mesh, sessions, plans):
+    """Replay MANY shards' pending tails in ONE mesh-sharded program.
+
+    `sessions`/`plans` are the fusable rows of a whole flush window —
+    every shard's bucket concatenated — all sharing (cap, max_ins).
+    Assembly is host-side slice bookkeeping: each session's resident
+    state is staged to host, stacked into the `[B, cap]` super-batch
+    (rows may live on different chips after earlier windows, so a
+    device-side stack would be a cross-device op), padded to the mesh
+    with inert rows (`lens = -1` sentinel, zero ops), placed with
+    `NamedSharding(mesh, P('docs'))`, and replayed by `mesh_flush_fn`
+    in a single dispatch with donated buffers.
+
+    Returns (ok-per-session, device_wait_s, padded_b). Per-doc poison
+    and the returned-length fence are byte-identical to `fused_replay`
+    (`adopt_results` is shared), so the bank's fallback ladder catches
+    violating rows exactly as before — and a violating doc in one
+    shard cannot corrupt another shard's rows."""
+    import time
+
+    import jax.numpy as jnp
+
+    from ..obs.devprof import note_transfer
+    from ..tpu.flush_fuse import adopt_results, pack_plans
+    from ..tpu.merge_kernel import _pow2
+
+    b = len(sessions)
+    assert b == len(plans) and b >= 1
+    cap = sessions[0].cap
+    mi = sessions[0].max_ins
+    ndev = int(mesh.devices.size)
+    n = _pow2(max(max(p.n_ops for p in plans), 1))
+    pos, dlen, ilen, chars = pack_plans(plans, n, mi, b)
+    pos, dlen, ilen, chars, bp = pad_batch_to_mesh(pos, dlen, ilen,
+                                                   chars, ndev)
+    docs_h = np.zeros((bp, cap), np.int32)
+    lens_h = np.full((bp,), -1, np.int32)    # padding sentinel rows
+    for i, s in enumerate(sessions):
+        docs_h[i] = np.asarray(s.docs)
+        lens_h[i] = int(np.asarray(s.lens))
+    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes
+                  + docs_h.nbytes + lens_h.nbytes)
+    sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+    fn = mesh_flush_fn(mesh, bp, n, mi, cap)
+    out_docs, out_lens = fn(*(jax.device_put(jnp.asarray(x), sh)
+                              for x in (docs_h, lens_h, pos, dlen,
+                                        ilen, chars)))
+    # the length fetch is the completion fence + parity cross-check
+    t_fence = time.perf_counter()
+    got = np.asarray(out_lens)
+    device_s = time.perf_counter() - t_fence
+    ok = adopt_results(sessions, plans, out_docs, out_lens, got)
+    return ok, device_s, bp
 
 
 def sharded_reach_fixed_point(mesh: Mesh, starts, edge_src, edge_plv,

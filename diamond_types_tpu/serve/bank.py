@@ -110,7 +110,8 @@ class SessionBank:
                  fused: bool = False,
                  fused_opts: Optional[dict] = None,
                  warmup: bool = False,
-                 flush_docs: int = 8) -> None:
+                 flush_docs: int = 8,
+                 mesh_shards: int = 0) -> None:
         if engine not in ("device", "host"):
             raise ValueError(f"unknown engine {engine!r}")
         self.shard_id = shard_id
@@ -126,6 +127,11 @@ class SessionBank:
         self.fused = bool(fused) and engine == "device"
         self.fused_opts = dict(fused_opts or {})
         self.flush_docs = int(flush_docs)
+        # >0: the scheduler runs mesh flush windows over this many
+        # shards — warmup then ALSO pre-compiles the mesh super-batch
+        # shape classes (B padded to the mesh) so the first window
+        # doesn't eat a cold compile
+        self.mesh_shards = int(mesh_shards)
         self.sessions: "OrderedDict[str, object]" = OrderedDict()
         self._resyncs_seen: Dict[str, int] = {}
         # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
@@ -149,7 +155,8 @@ class SessionBank:
             warmup_fused_cache(
                 flush_docs=self.flush_docs,
                 cap=self.fused_opts.get("cap", DEFAULT_CAP),
-                max_ins=self.fused_opts.get("max_ins", DEFAULT_MAX_INS))
+                max_ins=self.fused_opts.get("max_ins", DEFAULT_MAX_INS),
+                mesh_shards=self.mesh_shards)
         except Exception:   # pragma: no cover - warmup must never wedge
             pass
 
@@ -295,6 +302,73 @@ class SessionBank:
             return {"engine": "host", "steps": _HostDoc(oplog).sync(),
                     "error": f"{e.__class__.__name__}: {e}"[:200]}
 
+    def plan_window(self, items, resolve, oplog_lock=None,
+                    min_fuse: int = 2) -> dict:
+        """Plan-only entry point — the host-side half of `sync_docs`,
+        with NO device call issued. The mesh flush-window coordinator
+        (`scheduler._flush_window`) calls this on every shard's bucket,
+        concatenates the fusable rows into one mesh super-batch, issues
+        a single `shard_map` program, and hands each shard its results
+        back through `adopt_window`. `min_fuse=1` because even one
+        fusable doc joins the shared super-batch (the amortization
+        argument that demotes lone docs on the per-shard path doesn't
+        apply when the dispatch is shared).
+
+        Returns {"items", "ols", "serial", "groups"} where `groups` is
+        [(sessions, plans, doc_ids)] keyed by (cap, max_ins) class."""
+        import contextlib
+        olock = oplog_lock if oplog_lock is not None \
+            else contextlib.nullcontext()
+        # resolve first, outside every lock (non-reentrant store lock)
+        ols = {it.doc_id: resolve(it.doc_id) for it in items}
+        serial = list(items)
+        groups: List[tuple] = []     # (sessions, plans, doc_ids)
+        if self.fused and self.engine == "device":
+            serial, groups = self._plan_fused(items, ols, olock,
+                                              min_fuse=min_fuse)
+        return {"items": items, "ols": ols, "serial": serial,
+                "groups": groups}
+
+    def adopt_window(self, win: dict, failed: List[str],
+                     oplog_lock=None, device_lock=None) -> dict:
+        """Result adoption for one shard's slice of a flush window:
+        bump per-doc sync counters for the fused rows (commits already
+        happened at the device fence), evict `failed` docs — poisoned
+        (-1) or length-drift rows whose device state is untrusted — to
+        the host oracle, and run the serial fallback ladder for
+        everything that couldn't fuse. Shared tail of `sync_docs` and
+        the mesh window path, so the fallback ladder is one code path
+        regardless of which program replayed the batch."""
+        import contextlib
+        olock = oplog_lock if oplog_lock is not None \
+            else contextlib.nullcontext()
+        dlock = device_lock if device_lock is not None \
+            else contextlib.nullcontext()
+        out = {"docs": len(win["items"]), "fused_calls": 0,
+               "fused_docs": 0, "fallback_docs": 0}
+        for _sessions, _plans, doc_ids in win["groups"]:
+            for _d in doc_ids:
+                self._bump("syncs")
+        with olock:
+            for d in failed:
+                # poisoned (-1) or length-drift result: the session's
+                # device state is untrusted — evict it and serve the
+                # doc from the host oracle until its next rebuild
+                self.evict(d)
+                self._bump("host_fallbacks")
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "host_fallback", shard=self.shard_id, doc=d,
+                        error="fused_poisoned_or_len_mismatch")
+            for it in win["serial"]:
+                with dlock:
+                    self.sync_doc(it.doc_id, win["ols"][it.doc_id])
+            out["fallback_docs"] = len(win["serial"]) + len(failed)
+            if self.metrics is not None:
+                self.metrics.observe_footprint(self.shard_id,
+                                               self.footprint_slots())
+        return out
+
     def sync_docs(self, items, resolve,
                   oplog_lock=None, device_lock=None) -> dict:
         """Flush one taken bucket, fusing where possible (module
@@ -309,24 +383,14 @@ class SessionBank:
         Returns {"docs", "fused_calls", "fused_docs", "fallback_docs"}.
         """
         import contextlib
-        olock = oplog_lock if oplog_lock is not None \
-            else contextlib.nullcontext()
         dlock = device_lock if device_lock is not None \
             else contextlib.nullcontext()
-        # resolve first, outside every lock (non-reentrant store lock)
-        ols = {it.doc_id: resolve(it.doc_id) for it in items}
-
-        serial = list(items)
-        groups: List[tuple] = []     # (sessions, plans, doc_ids)
-        if self.fused and self.engine == "device":
-            serial, groups = self._plan_fused(items, ols, olock)
-
-        out = {"docs": len(items), "fused_calls": 0, "fused_docs": 0,
-               "fallback_docs": 0}
+        win = self.plan_window(items, resolve, oplog_lock=oplog_lock)
+        fused_calls = fused_docs = 0
         # ---- device phase: one jitted call per fused group, under the
         # device lock ONLY — host threads keep mutating other oplogs
         failed: List[str] = []
-        for sessions, plans, doc_ids in groups:
+        for sessions, plans, doc_ids in win["groups"]:
             from ..tpu.flush_fuse import fused_replay
             t0 = time.perf_counter()
             with dlock:
@@ -338,44 +402,29 @@ class SessionBank:
                     ok, device_s = fused_replay(sessions, plans)
             wall = time.perf_counter() - t0
             n = len(sessions)
-            out["fused_calls"] += 1
-            out["fused_docs"] += n
+            fused_calls += 1
+            fused_docs += n
             if self.metrics is not None:
                 self.metrics.record_fused(self.shard_id, n)
                 self.metrics.observe_device_time(self.shard_id, wall,
                                                  device_s)
             PROFILER.observe_fused(self.shard_id, wall, device_s, n)
-            for good, d in zip(ok, doc_ids):
-                self._bump("syncs")
-                if not good:
-                    failed.append(d)
+            failed.extend(d for good, d in zip(ok, doc_ids)
+                          if not good)
         # ---- host phase: per-doc fallbacks + poisoned-result cleanup
-        with olock:
-            for d in failed:
-                # poisoned (-1) or length-drift result: the session's
-                # device state is untrusted — evict it and serve the
-                # doc from the host oracle until its next rebuild
-                self.evict(d)
-                self._bump("host_fallbacks")
-                if self.recorder is not None:
-                    self.recorder.record(
-                        "host_fallback", shard=self.shard_id, doc=d,
-                        error="fused_poisoned_or_len_mismatch")
-            for it in serial:
-                with dlock:
-                    self.sync_doc(it.doc_id, ols[it.doc_id])
-            out["fallback_docs"] = len(serial) + len(failed)
-            if self.metrics is not None:
-                self.metrics.observe_footprint(self.shard_id,
-                                               self.footprint_slots())
+        out = self.adopt_window(win, failed, oplog_lock=oplog_lock,
+                                device_lock=device_lock)
+        out["fused_calls"] = fused_calls
+        out["fused_docs"] = fused_docs
         return out
 
-    def _plan_fused(self, items, ols, olock):
+    def _plan_fused(self, items, ols, olock, min_fuse: int = 2):
         """Host-side phase of the fused flush, under `olock`: get/build
         each doc's session, plan its tail, and group fusable sessions
         by (cap, max_ins). Anything that can't fuse — non-fused
         residency, overflowing tail, LRU-evicted mid-batch, a bucket
-        with <2 fusable docs — lands in the serial list."""
+        with fewer than `min_fuse` fusable docs — lands in the serial
+        list."""
         from ..tpu.flush_fuse import FusedDocSession
         serial = []
         fusable: List[tuple] = []    # (sess, plan, doc_id)
@@ -407,9 +456,10 @@ class SessionBank:
                     self._bump("syncs")
                 else:
                     fusable.append((sess, plan, it.doc_id))
-        if len(fusable) < 2:
-            # <2 fusable docs: the per-doc path amortizes nothing, so
-            # keep the simple ladder (sync_doc replans internally)
+        if len(fusable) < min_fuse:
+            # below min_fuse the per-doc path amortizes nothing on the
+            # per-shard path (the mesh coordinator passes min_fuse=1:
+            # its dispatch is shared, so lone docs still join)
             serial.extend(
                 next(it for it in items if it.doc_id == d)
                 for _s, _p, d in fusable)

@@ -125,13 +125,18 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     obs_sample_rate: float = 0.01,
                     fused: bool = True, flush_workers: bool = True,
                     warmup: bool = False,
-                    steady_rounds: int = 0) -> dict:
+                    steady_rounds: int = 0,
+                    mesh_window: bool = False) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
     report with throughput, the metrics snapshot, the parity gate, and
     the device-profiler snapshot (wall vs. device time per flush, jit
     cache hit/miss — obs/devprof). The bench runs with the production
     observability defaults (1% trace sampling) so its throughput
-    numbers ARE the instrumented numbers."""
+    numbers ARE the instrumented numbers. `mesh_window=True` routes
+    flushes through the scheduler's mesh flush-window coordinator (one
+    shard_map dispatch per window instead of one device call per
+    shard) — the report's `device_calls_per_window` is the direct
+    A/B signal against the per-shard default."""
     doc_ids = [f"doc{i:03d}" for i in range(docs)]
     ols: Dict[str, OpLog] = {}
     for d in doc_ids:
@@ -172,7 +177,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         flush_deadline_s=flush_deadline_s,
         place_on_devices=place_on_devices, session_opts=session_opts,
         sync_lock=oplog_lock, fused=fused,
-        flush_workers=flush_workers, warmup=warmup)
+        flush_workers=flush_workers, warmup=warmup,
+        mesh_window=mesh_window)
     obs = Observability(sample_rate=obs_sample_rate, seed=seed)
     sched.attach_obs(obs)
     if warmup:
@@ -261,7 +267,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                    "max_sessions": max_sessions, "seed": seed,
                    "fused": sched.fused,
                    "flush_workers": flush_workers, "warmup": warmup,
-                   "steady_rounds": steady_rounds},
+                   "steady_rounds": steady_rounds,
+                   "mesh_window": sched.mesh_window},
         "total_ops": total_ops,
         "submit_retries": retries,
         "feed_wall_s": round(feed_wall, 3),
@@ -271,6 +278,11 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         "parity_mismatches": mismatches,
         "fused_device_calls": m["fused"]["device_calls"],
         "fused_occupancy": m["fused"]["occupancy"],
+        # the N-dispatches-to-1 signal: device programs per flush
+        # window (mesh mode targets 1.0; the per-shard control pays one
+        # per due bucket)
+        "device_calls_per_window":
+            m["window"]["device_calls_per_window"],
         "metrics": m,
         "devprof": PROFILER.snapshot(),
         "obs": {"trace": obs.tracer.stats()},

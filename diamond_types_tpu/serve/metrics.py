@@ -7,7 +7,7 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"version": 5,                   # counter-set schema; bump on change
+  {"version": 6,                   # counter-set schema; bump on change
    "uptime_s": s,                  # monotonic since construction
    "shards": N, "flush_docs": B,
    "totals": {"submits", "coalesced", "rejects", "denied", "fenced",
@@ -21,6 +21,11 @@ Schema (snapshot()):
    "fused": {"device_calls", "docs",          # fused bucket replays
              "occupancy",                     # docs per device call
              "occupancy_hist": {"2": n, ...}},
+   "window": {"windows", "device_windows", "dispatches",
+              "device_calls_per_window",      # N->1 dispatch signal
+              "docs", "mesh_docs", "mesh_padded_rows",
+              "mesh_occupancy",               # docs / padded rows
+              "shards_hist": {"2": n, ...}},  # shards per window
    "max_depth_seen": d,
    "queue_bound_violations": 0,     # depth observed above max_pending
    "latencies": {"flush": hist},    # obs.hist snapshot w/ p50/p90/p99
@@ -53,8 +58,11 @@ class ServeMetrics:
     # the one this host holds; v4 = `latencies.flush` histogram and
     # per-shard `flush_wall_s`/`device_sync_s` device-time attribution;
     # v5 = fused-flush counters (`fused_calls`/`fused_docs`) and the
-    # `fused` occupancy block — docs folded per vmapped device call)
-    SCHEMA_VERSION = 5
+    # `fused` occupancy block — docs folded per vmapped device call;
+    # v6 = the `window` block — flush-window dispatch accounting
+    # (`device_calls_per_window` is the N-dispatches-to-1 signal the
+    # mesh flush window exists to move) + mesh super-batch occupancy)
+    SCHEMA_VERSION = 6
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -70,6 +78,15 @@ class ServeMetrics:
         self.flush_reasons: Dict[str, int] = {}
         self.flush_size_hist: Dict[int, int] = {}
         self.fused_occupancy_hist: Dict[int, int] = {}
+        # flush-window dispatch accounting (scheduler-level, not
+        # per-shard: a mesh window spans shards by construction)
+        self.windows = 0             # pump rounds that took >= 1 bucket
+        self.device_windows = 0      # windows issuing >= 1 device prog
+        self.window_dispatches = 0   # device programs / worker handoffs
+        self.window_docs = 0
+        self.mesh_docs = 0           # docs replayed via the mesh prog
+        self.mesh_padded_rows = 0    # super-batch rows incl. padding
+        self.window_shards_hist: Dict[int, int] = {}
         self.max_depth_seen = 0
         self.queue_bound_violations = 0
         self.queue_depth: List[int] = [0] * n_shards
@@ -111,6 +128,27 @@ class ServeMetrics:
             c["fused_docs"] += n_docs
             self.fused_occupancy_hist[n_docs] = \
                 self.fused_occupancy_hist.get(n_docs, 0) + 1
+
+    def record_window(self, dispatches: int, n_docs: int,
+                      n_shards: int, mesh_docs: int = 0,
+                      padded_rows: int = 0) -> None:
+        """One flush window: `dispatches` device programs (mesh path:
+        the number of shard_map calls, 1 for a uniform-shape window) or
+        per-shard worker handoffs (the PR-5 control, >= n_shards when
+        several shards' buckets are due) covering `n_docs` docs across
+        `n_shards` shards. `device_calls_per_window` in the snapshot is
+        dispatches / windows-with-device-work — the N-to-1 dispatch
+        claim, directly."""
+        with self._lock:
+            self.windows += 1
+            if dispatches > 0:
+                self.device_windows += 1
+            self.window_dispatches += dispatches
+            self.window_docs += n_docs
+            self.mesh_docs += mesh_docs
+            self.mesh_padded_rows += padded_rows
+            self.window_shards_hist[n_shards] = \
+                self.window_shards_hist.get(n_shards, 0) + 1
 
     def observe_device_time(self, shard: int, wall_s: float,
                             device_s: float) -> None:
@@ -178,6 +216,23 @@ class ServeMetrics:
                 "occupancy_hist": {
                     str(k): v for k, v in
                     sorted(self.fused_occupancy_hist.items())},
+            },
+            "window": {
+                "windows": self.windows,
+                "device_windows": self.device_windows,
+                "dispatches": self.window_dispatches,
+                "device_calls_per_window": round(
+                    self.window_dispatches
+                    / max(self.device_windows, 1), 4),
+                "docs": self.window_docs,
+                "mesh_docs": self.mesh_docs,
+                "mesh_padded_rows": self.mesh_padded_rows,
+                "mesh_occupancy": round(
+                    self.mesh_docs
+                    / max(self.mesh_padded_rows, 1), 4),
+                "shards_hist": {
+                    str(k): v for k, v in
+                    sorted(self.window_shards_hist.items())},
             },
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,

@@ -78,7 +78,8 @@ class MergeScheduler:
                  fused: bool = True,
                  fused_opts: Optional[dict] = None,
                  flush_workers: bool = True,
-                 warmup: bool = False) -> None:
+                 warmup: bool = False,
+                 mesh_window: bool = False) -> None:
         """`resolve(doc_id) -> OpLog` is the document authority —
         DocStore.get fits directly. `sync_lock` (e.g. DocStore.lock) is
         the OPLOG guard: held around host-side oplog reads (session
@@ -90,7 +91,13 @@ class MergeScheduler:
         flush-fuse sessions and replays whole buckets in one vmapped
         device call; `flush_workers=True` flushes through per-shard
         worker threads; `warmup=True` pre-compiles the fused kernels on
-        a background thread at construction."""
+        a background thread at construction. `mesh_window=True`
+        (fused device engine only) inverts the flush concurrency model:
+        instead of handing each shard's bucket to its own worker (N
+        device dispatches per window), `pump()` assembles EVERY due
+        shard's fusable tails into one mesh-sharded super-batch and
+        issues a single `shard_map` program over the `docs` axis —
+        see `_flush_window`."""
         self.resolve = resolve
         self._sync_lock = sync_lock if sync_lock is not None \
             else contextlib.nullcontext()
@@ -104,6 +111,10 @@ class MergeScheduler:
             from ..parallel.mesh import serve_shard_devices
             devices = serve_shard_devices(n_shards)
         self.fused = bool(fused) and engine == "device"
+        # mesh flush windows ride on fused sessions (the super-batch is
+        # assembled from FusedDocSession plan rows)
+        self.mesh_window = bool(mesh_window) and self.fused
+        self._mesh = None          # lazy: first window / warmup builds
         self.banks = [
             SessionBank(i, max_sessions=max_sessions_per_shard,
                         max_slots=max_slots_per_shard, engine=engine,
@@ -113,7 +124,9 @@ class MergeScheduler:
                         # the jit cache is process-global: one warmer
                         # covers every shard's shape classes
                         warmup=(warmup and i == 0),
-                        flush_docs=flush_docs)
+                        flush_docs=flush_docs,
+                        mesh_shards=(n_shards if self.mesh_window
+                                     else 0))
             for i in range(n_shards)]
         # per-DEVICE locks: shards placed on the same chip share one;
         # unplaced shards (device=None) get their own (the default
@@ -238,12 +251,23 @@ class MergeScheduler:
                 if items:
                     taken.append((shard, reason, items))
         synced = 0
-        for shard, reason, items in taken:
-            if self._flush_workers:
-                self._dispatch(shard, reason, items)
-            else:
-                self._flush_items(shard, reason, items)
-            synced += len(items)
+        if taken and self.mesh_window:
+            # window coordinator: every due shard's bucket folds into
+            # ONE mesh-sharded program instead of N worker dispatches
+            synced = self._flush_window(taken)
+        else:
+            for shard, reason, items in taken:
+                if self._flush_workers:
+                    self._dispatch(shard, reason, items)
+                else:
+                    self._flush_items(shard, reason, items)
+                synced += len(items)
+            if taken:
+                # the PR-5 control's dispatch accounting: one handoff
+                # (>= one device call) per taken bucket per window
+                self.metrics.record_window(
+                    len(taken), synced,
+                    len({s for s, _r, _i in taken}))
         if taken:
             with self.lock:
                 for shard in {s for s, _r, _i in taken}:
@@ -305,6 +329,29 @@ class MergeScheduler:
                 w.join(timeout=5)
                 self._workers[i] = None
 
+    def _fence(self, shard: int, items) -> list:
+        """Lease-epoch recheck: drop work admitted under an epoch this
+        host no longer holds (`fenced`) — its ops stay durable in the
+        oplog for the new owner. Shared by the per-shard flush (recheck
+        at merge time inside the worker) and the mesh window coordinator
+        (recheck at WINDOW ASSEMBLY — the last host-side moment before a
+        doc's rows join the shared super-batch)."""
+        if self.epoch_of is None:
+            return items
+        kept = []
+        for item in items:
+            if item.epoch != -1 \
+                    and self.epoch_of(item.doc_id) != item.epoch:
+                self.metrics.bump(shard, "fenced")
+                if self.obs is not None:
+                    self.obs.recorder.record("flush_fenced",
+                                             doc=item.doc_id,
+                                             shard=shard,
+                                             admit_epoch=item.epoch)
+            else:
+                kept.append(item)
+        return kept
+
     def _flush_items(self, shard: int, reason: str, items) -> None:
         """Sync one taken batch into its shard's bank, under that
         shard's lock only (items are already off the queue, so a
@@ -313,22 +360,9 @@ class MergeScheduler:
         epoch this host no longer holds is dropped (`fenced`), never
         merged — its ops are still in the oplog for the new owner."""
         obs = self.obs
-        if self.epoch_of is not None:
-            kept = []
-            for item in items:
-                if item.epoch != -1 \
-                        and self.epoch_of(item.doc_id) != item.epoch:
-                    self.metrics.bump(shard, "fenced")
-                    if obs is not None:
-                        obs.recorder.record("flush_fenced",
-                                            doc=item.doc_id,
-                                            shard=shard,
-                                            admit_epoch=item.epoch)
-                else:
-                    kept.append(item)
-            items = kept
-            if not items:
-                return
+        items = self._fence(shard, items)
+        if not items:
+            return
         fspan = NOOP_SPAN
         if obs is not None:
             parent = next(
@@ -358,6 +392,189 @@ class MergeScheduler:
         self.metrics.record_flush(
             shard, len(items), sum(i.n_ops for i in items), reason,
             dur_s=dur)
+
+    # ---- mesh flush window -----------------------------------------------
+
+    def _get_mesh(self):
+        """Lazy serve mesh over the shard devices (also built by bank
+        0's background warmup indirectly, via the shared jit cache).
+        Called BEFORE any shard lock is taken — it briefly needs the
+        global lock, and lock order is global → shard, never back."""
+        m = self._mesh
+        if m is None:
+            from ..parallel.mesh import serve_mesh
+            with self.lock:
+                if self._mesh is None:
+                    self._mesh = serve_mesh(len(self.banks))
+                m = self._mesh
+        return m
+
+    def _flush_window(self, taken) -> int:
+        """The mesh flush-window coordinator: ONE device program per
+        window instead of one per shard.
+
+        Every due bucket in `taken` — across ALL shards — goes through:
+
+          1. fencing recheck (window assembly is merge time here);
+          2. host-side planning per shard (`bank.plan_window`,
+             min_fuse=1: lone docs join the shared dispatch);
+          3. fusable rows concatenated ACROSS shards by (cap, max_ins)
+             shape class and replayed by `mesh_fused_replay` — one
+             `shard_map` program over the serve mesh's `docs` axis per
+             class (uniform-shape window ⇒ exactly one dispatch);
+          4. per-shard adoption (`bank.adopt_window`): poisoned /
+             length-drift rows evict to the host oracle, serial
+             leftovers run the per-doc ladder — the SAME fallback
+             ladder as the per-shard path, one rung higher.
+
+        A mesh replay failure drops its rows to the per-shard fused
+        rung (`_window_mesh_fallback`) before the per-doc/host rungs,
+        so the ladder is strictly widened, never bypassed.
+
+        Lock order: shard locks (sorted) → oplog lock (inside
+        plan/adopt) → device locks (sorted, deduped); the mesh device
+        phase holds ONLY the device locks of the shards in the window.
+        Returns the number of docs flushed (post-fencing)."""
+        from ..obs.devprof import PROFILER
+        from ..parallel.mesh import mesh_fused_replay
+        obs = self.obs
+        entries = []        # (shard, reason, items) — post-fencing
+        for shard, reason, items in taken:
+            items = self._fence(shard, items)
+            if items:
+                entries.append((shard, reason, items))
+        if not entries:
+            # an all-fenced window still counts (dispatches=0 keeps it
+            # out of the device_calls_per_window denominator)
+            self.metrics.record_window(
+                0, 0, len({s for s, _r, _i in taken}))
+            return 0
+        mesh = self._get_mesh()     # needs self.lock: before shard locks
+        shards = sorted({s for s, _r, _i in entries})
+        n_docs = sum(len(i) for _s, _r, i in entries)
+        fspan = NOOP_SPAN
+        if obs is not None:
+            parent = next((i.trace for _s, _r, its in entries
+                           for i in its if i.trace is not None), None)
+            if parent is not None:
+                fspan = obs.tracer.start(
+                    "serve.mesh_window", parent=parent,
+                    attrs={"shards": len(shards), "docs": n_docs})
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as sstack:
+            for s in shards:
+                sstack.enter_context(self._shard_locks[s])
+            wins = [self.banks[s].plan_window(
+                        items, self.resolve, oplog_lock=self._sync_lock,
+                        min_fuse=1)
+                    for s, _r, items in entries]
+            # concatenate fusable rows across shards by shape class —
+            # rows sharing (cap, max_ins) share one mesh program
+            classes: Dict[tuple, list] = {}
+            for ei, (s, _r, _items) in enumerate(entries):
+                for sessions, plans, doc_ids in wins[ei]["groups"]:
+                    for sess, plan, d in zip(sessions, plans, doc_ids):
+                        classes.setdefault(
+                            (sess.cap, sess.max_ins), []).append(
+                                (ei, s, sess, plan, d))
+            # device locks of the window's shards, sorted + deduped
+            # (co-located shards share a lock object)
+            dlocks, seen = [], set()
+            for s in shards:
+                lk = self._device_locks[s]
+                if id(lk) not in seen:
+                    seen.add(id(lk))
+                    dlocks.append(lk)
+            dispatches = mesh_docs = padded_rows = 0
+            failed: List[List[str]] = [[] for _ in entries]
+            for (cap, mi), rows in sorted(classes.items()):
+                sessions = [r[2] for r in rows]
+                plans = [r[3] for r in rows]
+                t_cls = time.perf_counter()
+                with contextlib.ExitStack() as dstack:
+                    for lk in dlocks:
+                        dstack.enter_context(lk)
+                    dspan = NOOP_SPAN if not fspan.sampled else \
+                        obs.tracer.start(
+                            "serve.mesh_dispatch",
+                            parent=fspan.context(),
+                            attrs={"docs": len(rows), "cap": cap,
+                                   "max_ins": mi})
+                    try:
+                        ok, device_s, bp = mesh_fused_replay(
+                            mesh, sessions, plans)
+                        dispatches += 1
+                        mesh_docs += len(rows)
+                        padded_rows += bp
+                        dspan.end(padded_b=bp)
+                    except Exception as e:
+                        # mesh rung failed: these rows drop to the
+                        # per-shard fused rung; whatever that can't
+                        # recover falls per-doc/host in adoption
+                        if obs is not None:
+                            obs.recorder.record(
+                                "mesh_window_fallback",
+                                docs=len(rows), cap=cap,
+                                error=f"{e.__class__.__name__}: "
+                                      f"{e}"[:120])
+                        ok, device_s, calls = \
+                            self._window_mesh_fallback(rows)
+                        dispatches += calls
+                        dspan.end(outcome="fallback")
+                wall = time.perf_counter() - t_cls
+                PROFILER.observe_window(wall, device_s, len(rows),
+                                        len(shards))
+                for good, (ei, _s, _sess, _plan, d) in zip(ok, rows):
+                    if not good:
+                        failed[ei].append(d)
+            # adoption + per-bucket flush accounting, per shard
+            for ei, (s, reason, items) in enumerate(entries):
+                self.banks[s].adopt_window(
+                    wins[ei], failed[ei], oplog_lock=self._sync_lock,
+                    device_lock=self._device_locks[s])
+                self.metrics.record_flush(
+                    s, len(items), sum(i.n_ops for i in items), reason,
+                    dur_s=time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        fspan.end(dur_s=round(dur, 6), dispatches=dispatches)
+        self.metrics.record_window(dispatches, n_docs, len(shards),
+                                   mesh_docs=mesh_docs,
+                                   padded_rows=padded_rows)
+        return n_docs
+
+    def _window_mesh_fallback(self, rows):
+        """Mesh rung failed for one shape class: re-run its rows
+        through the PR-5 per-shard fused rung, grouped back by shard.
+        Rows a shard's replay can't recover (or whose replay raises
+        too) stay failed and fall to the per-doc/host rungs in
+        adoption. Returns (ok, device_s, dispatches) with `ok` aligned
+        to `rows`."""
+        from ..tpu.flush_fuse import fused_replay
+        ok = [False] * len(rows)
+        device_s = 0.0
+        calls = 0
+        by_shard: Dict[int, List[int]] = {}
+        for idx, (_ei, s, _sess, _plan, _d) in enumerate(rows):
+            by_shard.setdefault(s, []).append(idx)
+        for s, idxs in sorted(by_shard.items()):
+            bank = self.banks[s]
+            sess = [rows[i][2] for i in idxs]
+            plans = [rows[i][3] for i in idxs]
+            try:
+                if bank.device is not None:
+                    import jax
+                    with jax.default_device(bank.device):
+                        oks, ds = fused_replay(sess, plans)
+                else:
+                    oks, ds = fused_replay(sess, plans)
+                calls += 1
+                device_s += ds
+                self.metrics.record_fused(s, len(idxs))
+                for i, good in zip(idxs, oks):
+                    ok[i] = good
+            except Exception:
+                pass    # rows stay failed → host fallback in adoption
+        return ok, device_s, calls
 
     def drain(self) -> int:
         """Flush everything regardless of triggers (shutdown, rebalance,

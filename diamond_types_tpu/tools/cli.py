@@ -248,7 +248,8 @@ def cmd_serve_bench(args) -> int:
               max_pending=args.max_pending,
               max_sessions=args.max_sessions, seed=args.seed,
               fused=args.fused, flush_workers=args.workers,
-              warmup=args.warmup, steady_rounds=args.steady_rounds)
+              warmup=args.warmup, steady_rounds=args.steady_rounds,
+              mesh_window=args.mesh_window)
     if args.dry_run:
         # CI smoke preset: host engine, tiny workload, no jax needed
         kw.update(shards=2, docs=4, txns=6, engine="host",
@@ -271,6 +272,8 @@ def cmd_serve_bench(args) -> int:
               f"occupancy {m['batch_occupancy']}, "
               f"fused calls {report['fused_device_calls']} "
               f"@ {report['fused_occupancy']} docs/call, "
+              f"{report['device_calls_per_window']} device calls/"
+              f"window, "
               f"parity {'OK' if report['parity_ok'] else 'MISMATCH'}")
     return 0 if report["parity_ok"] else 1
 
@@ -461,6 +464,12 @@ def main(argv=None) -> int:
                    default=True,
                    help="per-shard flush worker threads "
                    "(--no-workers = inline serial pump)")
+    c.add_argument("--mesh-window",
+                   action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="mesh flush windows: every due shard's bucket "
+                   "replayed in ONE shard_map dispatch per window "
+                   "(default: one device call per shard)")
     c.add_argument("--warmup", action="store_true",
                    help="pre-compile the fused jit kernels before "
                    "feeding (keeps compiles off the flush path)")

@@ -56,6 +56,41 @@ _fused_jit_cache = {}
 _fused_jit_lock = threading.Lock()
 
 
+def make_replay_body(mi: int):
+    """The fused-tail replay body, shared by the per-shard jit
+    (`_fused_fn`) and the mesh flush program
+    (`parallel.mesh.mesh_flush_fn`, which wraps it in `shard_map` over
+    the `docs` axis — the body is pure data parallel, so partitioning
+    the batch axis needs no collectives). Per-doc poison: a
+    bounded-shift violation is zeroed to a no-op and only ITS doc's
+    length comes back -1, so one bad doc never corrupts batch (or, on
+    the mesh path, other shards') neighbors. Rows whose incoming length
+    is the -1 padding sentinel and whose ops are all zero stay at -1 —
+    inert mesh padding rows survive the kernel identifiably."""
+    import jax
+    import jax.numpy as jnp
+
+    from .batch import _apply_ops_batched
+
+    def run(docs, lens, pos, dlen, ilen, chars):
+        bad = (dlen > mi) | (ilen > mi)
+        dlen = jnp.where(bad, 0, dlen)
+        ilen = jnp.where(bad, 0, ilen)
+        bad_doc = jnp.any(bad, axis=1)
+
+        def step(carry, op):
+            d, l, p, dl, il, c = carry + op
+            d, l = _apply_ops_batched(d, l, p, dl, il, c)
+            return (d, l), None
+
+        ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
+               jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
+        (docs, lens), _ = jax.lax.scan(step, (docs, lens), ops)
+        return docs, jnp.where(bad_doc, -1, lens)
+
+    return run
+
+
 def _fused_fn(b: int, n: int, mi: int, cap: int):
     """Jitted fused-tail replay for batch `b`, `n` ops/doc, `max_ins`
     `mi`, capacity `cap` — all static, all powers of two, so the cache
@@ -69,45 +104,33 @@ def _fused_fn(b: int, n: int, mi: int, cap: int):
         note_jit_lookup("fused", fn is not None)
         if fn is not None:
             return fn
-        import jax.numpy as jnp
-
-        from .batch import _apply_ops_batched
-
-        def run(docs, lens, pos, dlen, ilen, chars):
-            # bounded-shift contract check, PER DOC: a violating op is
-            # zeroed to a no-op and only its own doc's length is
-            # poisoned to -1 — bucket neighbors keep their result
-            bad = (dlen > mi) | (ilen > mi)
-            dlen = jnp.where(bad, 0, dlen)
-            ilen = jnp.where(bad, 0, ilen)
-            bad_doc = jnp.any(bad, axis=1)
-
-            def step(carry, op):
-                d, l, p, dl, il, c = carry + op
-                d, l = _apply_ops_batched(d, l, p, dl, il, c)
-                return (d, l), None
-
-            ops = (jnp.swapaxes(pos, 0, 1), jnp.swapaxes(dlen, 0, 1),
-                   jnp.swapaxes(ilen, 0, 1), jnp.swapaxes(chars, 0, 1))
-            (docs, lens), _ = jax.lax.scan(step, (docs, lens), ops)
-            return docs, jnp.where(bad_doc, -1, lens)
-
-        fn = jax.jit(run, donate_argnums=(0, 1))
+        fn = jax.jit(make_replay_body(mi), donate_argnums=(0, 1))
         _fused_jit_cache[key] = fn
         return fn
 
 
 def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
                        max_ins: int = DEFAULT_MAX_INS,
-                       shape_classes: Sequence[int] = WARMUP_SHAPE_CLASSES
-                       ) -> int:
+                       shape_classes: Sequence[int] = WARMUP_SHAPE_CLASSES,
+                       mesh_shards: int = 0) -> int:
     """Compile the fused kernel for every (batch, ops) shape class a
     bank configured with `flush_docs` can emit, so the first REAL flush
     hits a warm jit cache instead of eating a compile on the request
     path. Returns the number of kernels compiled. Hits/misses surface
-    through the existing `devprof.jit_cache` fields (cache "fused")."""
+    through the existing `devprof.jit_cache` fields (cache "fused").
+
+    `mesh_shards > 0` additionally pre-compiles the MESH flush program
+    (`parallel.mesh.mesh_flush_fn`) for every super-batch shape class a
+    `mesh_shards`-shard window can assemble — B padded to the mesh per
+    `pad_batch_to_mesh` — so the first mesh window doesn't eat a cold
+    compile either (cache "mesh")."""
+    import jax
     import jax.numpy as jnp
 
+    # sessions materialize at _pow2(max(len * headroom, cap, 256)) —
+    # warm the floor class a fresh session actually lands on, not the
+    # raw configured cap (which may name a class no session ever uses)
+    cap = _pow2(max(int(cap), 256))
     compiled = 0
     batches = sorted({1} | {_pow2(k) for k in range(2, flush_docs + 1)})
     for b in batches:
@@ -119,9 +142,34 @@ def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
             z = jnp.zeros((b, n), jnp.int32)
             ch = jnp.zeros((b, n, max_ins), jnp.int32)
             out_docs, out_lens = fn(docs, lens, z, z, z, ch)
-            import jax
             jax.block_until_ready(out_lens)
             compiled += 1
+    if mesh_shards > 0:
+        from ..parallel.mesh import (mesh_flush_fn, pad_batch_count,
+                                     serve_mesh)
+        mesh = serve_mesh(mesh_shards)
+        ndev = mesh.devices.size
+        sh = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(mesh.axis_names[0]))
+        # a window can fold up to mesh_shards * flush_docs docs; the
+        # padded-B classes below are exactly what pad_batch_count can
+        # emit for any b in that range (O(log) classes)
+        bps = sorted({pad_batch_count(b, ndev)
+                      for b in range(1, mesh_shards * flush_docs + 1)})
+        for bp in bps:
+            for ncls in shape_classes:
+                n = _pow2(ncls)
+                fn = mesh_flush_fn(mesh, bp, n, max_ins, cap)
+                docs = jax.device_put(
+                    jnp.zeros((bp, cap), jnp.int32), sh)
+                lens = jax.device_put(
+                    jnp.full((bp,), -1, jnp.int32), sh)
+                z = jax.device_put(jnp.zeros((bp, n), jnp.int32), sh)
+                ch = jax.device_put(
+                    jnp.zeros((bp, n, max_ins), jnp.int32), sh)
+                _out, out_lens = fn(docs, lens, z, z, z, ch)
+                jax.block_until_ready(out_lens)
+                compiled += 1
     return compiled
 
 
@@ -306,6 +354,45 @@ class FusedDocSession:
         return int(self.cap)
 
 
+def pack_plans(plans: Sequence[TailPlan], n: int, mi: int,
+               bp: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+    """Stack `plans` into dense host-side op arrays
+    (pos/dlen/ilen [bp, n], chars [bp, n, mi]). Rows past len(plans)
+    are all-zero no-ops — the inert padding the batch pow2 rounding
+    (and the mesh super-batch divisibility padding) relies on. Shared
+    by `fused_replay` and the mesh window's super-batch assembly."""
+    pos = np.zeros((bp, n), np.int32)
+    dlen = np.zeros((bp, n), np.int32)
+    ilen = np.zeros((bp, n), np.int32)
+    chars = np.zeros((bp, n, mi), np.int32)
+    for i, p in enumerate(plans):
+        k = p.n_ops
+        pos[i, :k] = p.pos
+        dlen[i, :k] = p.dlen
+        ilen[i, :k] = p.ilen
+        chars[i, :k] = p.chars
+    return pos, dlen, ilen, chars
+
+
+def adopt_results(sessions: Sequence[FusedDocSession],
+                  plans: Sequence[TailPlan],
+                  out_docs, out_lens,
+                  got: np.ndarray) -> List[bool]:
+    """The returned-length fence: commit each session whose device
+    length matches the host-side projection; a poisoned (-1) or
+    drifting row is NOT committed (the caller evicts it and serves the
+    doc from the host engine). Shared by the per-shard and mesh paths
+    so the fallback ladder fences identically in both."""
+    ok: List[bool] = []
+    for i, (sess, plan) in enumerate(zip(sessions, plans)):
+        good = int(got[i]) == plan.new_len and int(got[i]) >= 0
+        if good:
+            sess.commit(out_docs[i], out_lens[i], plan)
+        ok.append(good)
+    return ok
+
+
 def fused_replay(sessions: List[FusedDocSession],
                  plans: List[TailPlan]
                  ) -> Tuple[List[bool], float]:
@@ -331,16 +418,7 @@ def fused_replay(sessions: List[FusedDocSession],
     mi = sessions[0].max_ins
     n = _pow2(max(max(p.n_ops for p in plans), 1))
     bp = _pow2(b) if b > 1 else 1
-    pos = np.zeros((bp, n), np.int32)
-    dlen = np.zeros((bp, n), np.int32)
-    ilen = np.zeros((bp, n), np.int32)
-    chars = np.zeros((bp, n, mi), np.int32)
-    for i, p in enumerate(plans):
-        k = p.n_ops
-        pos[i, :k] = p.pos
-        dlen[i, :k] = p.dlen
-        ilen[i, :k] = p.ilen
-        chars[i, :k] = p.chars
+    pos, dlen, ilen, chars = pack_plans(plans, n, mi, bp)
     from ..obs.devprof import note_transfer
     note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes)
     docs = jnp.stack([s.docs for s in sessions]
@@ -356,10 +434,5 @@ def fused_replay(sessions: List[FusedDocSession],
     t_fence = time.perf_counter()
     got = np.asarray(out_lens)
     device_s = time.perf_counter() - t_fence
-    ok: List[bool] = []
-    for i, (sess, plan) in enumerate(zip(sessions, plans)):
-        good = int(got[i]) == plan.new_len and int(got[i]) >= 0
-        if good:
-            sess.commit(out_docs[i], out_lens[i], plan)
-        ok.append(good)
-    return ok, device_s
+    return adopt_results(sessions, plans, out_docs, out_lens, got), \
+        device_s
