@@ -1,0 +1,36 @@
+"""Concurrency invariant analysis: static lint + runtime lock witness.
+
+Two halves of one correctness story the chaos soaks only sample:
+
+  lint.py / rules/   AST-based linter over serve/, replicate/, tpu/,
+                     parallel/ and tools/ — lock-order violations,
+                     unsorted multi-lock acquisition, device dispatch
+                     under the global/oplog lock, unfenced doc-state
+                     mutation on write paths, impurity inside
+                     jitted/shard_map bodies. CLI: `dt-lint`.
+  witness.py         lockdep-style instrumented Lock wrapper, off by
+                     default; records actual held-while-acquiring
+                     edges during tests/soaks and asserts the global
+                     lock-order graph stays acyclic.
+
+The canonical lock order both halves enforce (serve/README.md
+"Concurrency invariants"): replicate maintenance → leases →
+membership/peers/quorum → scheduler global → sorted shard locks →
+oplog guard → sorted per-device locks → leaf (jit caches, first-touch
+init, io).
+"""
+
+from __future__ import annotations
+
+from .lint import (Violation, last_report, publish_report, render_human,
+                   run_lint)
+from .witness import (WitnessLock, make_lock, witness_assert_acyclic,
+                      witness_disable, witness_enable, witness_reset,
+                      witness_snapshot)
+
+__all__ = [
+    "Violation", "run_lint", "render_human", "publish_report",
+    "last_report",
+    "WitnessLock", "make_lock", "witness_enable", "witness_disable",
+    "witness_reset", "witness_snapshot", "witness_assert_acyclic",
+]

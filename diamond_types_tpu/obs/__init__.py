@@ -56,7 +56,17 @@ class Observability:
         self.hist = HistogramSet()
 
     def snapshot(self) -> dict:
-        return {"trace": self.tracer.stats(),
-                "recorder": self.recorder.stats(),
-                "http": self.hist.snapshot(),
-                "devprof": PROFILER.snapshot()}
+        out = {"trace": self.tracer.stats(),
+               "recorder": self.recorder.stats(),
+               "http": self.hist.snapshot(),
+               "devprof": PROFILER.snapshot()}
+        # concurrency-invariant tier (analysis/): the runtime lock
+        # witness is always reported (enabled=False when off); the
+        # lint block appears once a dt-lint run published a report in
+        # this process
+        from ..analysis import last_report, witness_snapshot
+        out["witness"] = witness_snapshot()
+        lint = last_report()
+        if lint is not None:
+            out["lint"] = lint
+        return out

@@ -198,6 +198,28 @@ def _render_obs(b: _Builder, obs: dict) -> None:
               dp.get("device_sync_s", 0.0))
         b.add("dt_devprof_transfer_bytes_total", "counter",
               dp.get("transfer_bytes", 0))
+    wit = obs.get("witness") or {}
+    if wit:
+        # one gauge per observed class edge (small, bounded by the
+        # canonical order's class count squared) + scalar summary
+        b.add("dt_witness_enabled", "gauge",
+              1 if wit.get("enabled") else 0)
+        b.add("dt_witness_acquires_total", "counter",
+              wit.get("acquires", 0))
+        b.add("dt_witness_violations_total", "counter",
+              wit.get("violation_count", 0))
+        b.add("dt_witness_acyclic", "gauge",
+              1 if wit.get("acyclic", True) else 0)
+        for edge, n in sorted((wit.get("edges") or {}).items()):
+            b.add("dt_witness_edges", "gauge", n,
+                  labels={"edge": edge})
+    lint = obs.get("lint") or {}
+    if lint:
+        for rule, n in sorted((lint.get("by_rule") or {}).items()):
+            b.add("dt_lint_violations_total", "counter", n,
+                  labels={"rule": rule})
+        b.add("dt_lint_files", "gauge", lint.get("files", 0))
+        b.add("dt_lint_ok", "gauge", 1 if lint.get("ok") else 0)
 
 
 def render_metrics(doc: dict) -> str:

@@ -126,7 +126,8 @@ def pad_batch_to_mesh(pos, dlen, ilen, chars, n_devices: int):
 
 
 _mesh_jit_cache = {}
-_mesh_jit_lock = threading.Lock()
+from ..analysis.witness import make_lock as _make_lock
+_mesh_jit_lock = _make_lock("mesh_jit", "leaf")
 
 
 def mesh_flush_fn(mesh: Mesh, b: int, n: int, mi: int, cap: int):

@@ -75,7 +75,8 @@ class MembershipView:
                  metrics: Optional[ReplicationMetrics] = None) -> None:
         self.self_id = self_id
         self.metrics = metrics
-        self._lock = threading.Lock()
+        from ..analysis.witness import make_lock
+        self._lock = make_lock("repl.membership", "repl.membership")
         self.members: Dict[str, Member] = {
             self_id: Member(self_id, ALIVE, incarnation)}
         self.view_version = 1

@@ -133,7 +133,8 @@ class ReplicaNode:
         # docs whose merges this host has admitted — the test surface
         # for the exactly-one-merger property
         self.merged_docs: Set[str] = set()
-        self._maintain_lock = threading.Lock()
+        from ..analysis.witness import make_lock
+        self._maintain_lock = make_lock("repl.maintain", "repl.maintain")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

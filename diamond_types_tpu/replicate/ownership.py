@@ -147,7 +147,9 @@ class LeaseManager:
         # obs.recorder.FlightRecorder (wired by node.ReplicaNode);
         # every lease transition is rare enough to record
         self.recorder = None
-        self.lock = threading.RLock()
+        from ..analysis.witness import make_lock
+        self.lock = make_lock("repl.leases", "repl.leases",
+                              reentrant=True)
 
     def _bump(self, key: str, n: int = 1) -> None:
         if self.metrics is not None:

@@ -120,7 +120,8 @@ class PeerTable:
         # gossip hook: on_ping(peer_id, parsed_ping_body) — wired by
         # ReplicaNode to fold the responder's member table
         self.on_ping: Optional[Callable[[str, dict], None]] = None
-        self._lock = threading.Lock()
+        from ..analysis.witness import make_lock
+        self._lock = make_lock("repl.peers", "repl.peers")
         self.peers: Dict[str, _PeerState] = {}
         for addr in peer_addrs:
             if addr and addr != self_id:

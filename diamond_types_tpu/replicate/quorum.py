@@ -80,7 +80,10 @@ class ReplicaJournal:
     def __init__(self, prefix: str) -> None:
         os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
         self.prefix = prefix
-        self._lock = threading.Lock()
+        # journal writes nest under the lease lock and take nothing
+        # further — a leaf in the canonical order
+        from ..analysis.witness import make_lock
+        self._lock = make_lock("repl.journal", "leaf")
         self.state: dict = {"incarnation": 0, "max_epoch": {},
                             "leases": {}, "promises": {}}
         try:

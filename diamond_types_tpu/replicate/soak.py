@@ -79,6 +79,7 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
                        serve_shards: int = 0,
                        crash: bool = False, asym: bool = False,
                        churn: bool = False,
+                       witness: Optional[bool] = None,
                        data_dir: Optional[str] = None,
                        progress: bool = False) -> dict:
     from ..tools.server import SyncClient, serve
@@ -88,6 +89,14 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
     # force at least one serve shard
     if (crash or asym or churn) and serve_shards == 0:
         serve_shards = 1
+    # runtime lock witness: on by default for the chaos modes — those
+    # are exactly the runs whose thread interleavings are worth mining
+    # for lock-order edges (witness=False forces it off, True forces on)
+    use_witness = witness if witness is not None else (crash or churn)
+    if use_witness:
+        from ..analysis import witness_enable, witness_reset
+        witness_reset()
+        witness_enable()
     rng = random.Random(seed)
     faults = FaultInjector(seed=seed, drop_rate=drop_rate,
                            dup_rate=dup_rate, delay_rate=delay_rate,
@@ -342,7 +351,25 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
         "wall_s": round(time.monotonic() - t0, 3),
         "metrics": {n.self_id: n.metrics_json() for n in live_nodes},
     }
-    if not (converged and not split_brain):
+    if use_witness:
+        # the observed lock-order graph across every thread the soak
+        # ran (flush workers, maintenance loops, HTTP handlers): a
+        # cycle is a latent deadlock the run merely didn't lose the
+        # race to, so acyclicity joins the verdict
+        from ..analysis import witness_disable, witness_snapshot
+        snap = witness_snapshot()
+        witness_disable()
+        report["lock_witness"] = {
+            "acquires": snap["acquires"],
+            "edge_count": snap["edge_count"],
+            "edges": snap["edges"],
+            "violation_count": snap["violation_count"],
+            "cycles": snap["cycles"],
+            "acyclic": snap["acyclic"]
+            and not snap["violation_count"],
+        }
+    if not (converged and not split_brain
+            and report.get("lock_witness", {}).get("acyclic", True)):
         # flight-recorder tail makes a failed soak diagnosable from the
         # JSON report alone: last 50 events across all live recorders
         events = []
@@ -401,6 +428,8 @@ def main(argv=None) -> int:  # pragma: no cover - exercised via cli.py
         k: report[k] for k in ("converged", "edits_applied",
                                "split_brain", "zero_split_brain",
                                "crashes", "fencing",
-                               "multi_merger_docs", "wall_s")}))
-    return 0 if report["converged"] and report["zero_split_brain"] \
-        else 1
+                               "multi_merger_docs", "wall_s")
+        if k in report}))
+    return 0 if (report["converged"] and report["zero_split_brain"]
+                 and report.get("lock_witness",
+                                {}).get("acyclic", True)) else 1

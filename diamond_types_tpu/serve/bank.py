@@ -64,7 +64,8 @@ from .metrics import ServeMetrics
 # is process-global and racy, so the FIRST device touch runs exactly
 # once under this module lock; every later device call relies on JAX's
 # own thread safety plus the scheduler's per-device locks.
-_first_touch_lock = threading.Lock()
+from ..analysis.witness import make_lock as _make_lock
+_first_touch_lock = _make_lock("first_touch", "leaf")
 _first_touch_done = False
 
 
@@ -362,7 +363,15 @@ class SessionBank:
                         error="fused_poisoned_or_len_mismatch")
             for it in win["serial"]:
                 with dlock:
-                    self.sync_doc(it.doc_id, win["ols"][it.doc_id])
+                    # The serial fallback rung interleaves oplog reads
+                    # (span walk, agent keys, host checkouts) with its
+                    # device continuation inside one sess.sync(), so it
+                    # cannot drop the oplog guard the way the fused
+                    # phases do. It is the rare rung — unfusable,
+                    # overflowing or poisoned docs — and stalling
+                    # oplog readers here is the documented cost of
+                    # falling off the fused path.
+                    self.sync_doc(it.doc_id, win["ols"][it.doc_id])  # dt-lint: ignore[device-under-lock]
             out["fallback_docs"] = len(win["serial"]) + len(failed)
             if self.metrics is not None:
                 self.metrics.observe_footprint(self.shard_id,
@@ -475,15 +484,28 @@ class SessionBank:
         ) for grp in by_shape.values()]
         return serial, groups
 
-    def text(self, doc_id: str, oplog) -> str:
-        """Merged text for the doc — from the resident session when one
-        exists (device parity surface), host checkout otherwise."""
-        sess = self.sessions.get(doc_id)
-        if sess is None:
-            return oplog.checkout_tip().snapshot()
-        if getattr(sess, "synced_to", 0) < len(oplog):
-            self.sync_doc(doc_id, oplog)
+    def text(self, doc_id: str, oplog, oplog_lock=None,
+             device_lock=None) -> str:
+        """Merged text for the doc — from the resident session when it
+        is caught up with the durable oplog (device parity surface),
+        host checkout otherwise. Lock discipline matches the flush
+        phases: host-side reads (session table, oplog checkout) under
+        `oplog_lock`; the device fetch under `device_lock` only. A read
+        never issues device work while holding the oplog guard — a
+        stale session serves the durable tip and the flush pipeline
+        catches it up off the read path."""
+        import contextlib
+        olock = oplog_lock if oplog_lock is not None \
+            else contextlib.nullcontext()
+        dlock = device_lock if device_lock is not None \
+            else contextlib.nullcontext()
+        with olock:
             sess = self.sessions.get(doc_id)
-            if sess is None:     # sync fell back + evicted
+            if sess is None \
+                    or getattr(sess, "synced_to", 0) < len(oplog):
                 return oplog.checkout_tip().snapshot()
-        return sess.text()
+            if self.engine == "host":
+                # host sessions read the oplog itself; stay guarded
+                return sess.text()
+        with dlock:
+            return sess.text()

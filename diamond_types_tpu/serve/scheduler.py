@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.witness import make_lock
 from ..obs.trace import NOOP_SPAN
 from .admission import AdmissionQueue, Backpressure
 from .bank import SessionBank
@@ -132,13 +133,17 @@ class MergeScheduler:
         # unplaced shards (device=None) get their own (the default
         # device is thread-safe — contention there is a perf matter,
         # not a correctness one)
-        by_dev: Dict[int, threading.Lock] = {}
-        self._device_locks: List[threading.Lock] = []
+        by_dev: Dict[int, object] = {}
+        self._device_locks: List = []
         for i, dev in enumerate(devices):
             key = id(dev) if dev is not None else ("shard", i)
             lock = by_dev.get(key)
             if lock is None:
-                lock = by_dev[key] = threading.Lock()
+                # witness rank = the first shard index mapped to the
+                # device, so rank order == the sorted-shard-list
+                # acquisition order _flush_window uses
+                lock = by_dev[key] = make_lock(
+                    f"device[{i}]", "device", rank=i)
             self._device_locks.append(lock)
         # `admit(doc_id) -> bool` — the cross-host ownership gate
         # (replicate.ReplicaNode.owns); None = single-host, admit all
@@ -149,8 +154,9 @@ class MergeScheduler:
         # obs.Observability bundle (attach_obs); None = zero overhead:
         # every obs touchpoint below is guarded by this one attribute
         self.obs = None
-        self.lock = threading.Lock()
-        self._shard_locks = [threading.Lock() for _ in range(n_shards)]
+        self.lock = make_lock("scheduler.global", "global")
+        self._shard_locks = [make_lock(f"shard[{i}]", "shard", rank=i)
+                             for i in range(n_shards)]
         self._pump_stop = threading.Event()
         self._pump_thread: Optional[threading.Thread] = None
         # per-shard flush workers (lazy-spawned daemons): pump() hands
@@ -477,14 +483,15 @@ class MergeScheduler:
                         classes.setdefault(
                             (sess.cap, sess.max_ins), []).append(
                                 (ei, s, sess, plan, d))
-            # device locks of the window's shards, sorted + deduped
-            # (co-located shards share a lock object)
-            dlocks, seen = [], set()
-            for s in shards:
-                lk = self._device_locks[s]
-                if id(lk) not in seen:
-                    seen.add(id(lk))
-                    dlocks.append(lk)
+            # device locks of the window's shards, deduped in shard
+            # order (co-located shards share a lock object). The
+            # comprehension runs directly over the sorted shard list so
+            # the acquisition order is lexically evident (dt-lint
+            # unsorted-locks) and matches the witness's rank order.
+            seen: set = set()
+            dlocks = [lk for s in shards
+                      if id(lk := self._device_locks[s]) not in seen
+                      and not seen.add(id(lk))]
             dispatches = mesh_docs = padded_rows = 0
             failed: List[List[str]] = [[] for _ in entries]
             for (cap, mi), rows in sorted(classes.items()):
@@ -594,7 +601,10 @@ class MergeScheduler:
     def text(self, doc_id: str) -> str:
         """Merged text from the doc's shard (device-resident state when
         present). Pending queued work for the doc is flushed first so
-        the answer reflects every accepted submit."""
+        the answer reflects every accepted submit. Reads never dispatch
+        device work under the oplog guard: a session behind the durable
+        oplog serves the oplog's tip snapshot instead, and the flush
+        pipeline catches it up off the read path."""
         with self.lock:
             shard = self.router.assign(doc_id)
             bucket = self.queue.pending_bucket(shard, doc_id)
@@ -610,9 +620,16 @@ class MergeScheduler:
                 self.metrics.observe_queue(shard,
                                            self.queue.depth(shard))
         ol = self.resolve(doc_id)
-        with self._shard_locks[shard]:
+        # cross-host ownership gate: a deposed or never-owner host must
+        # not serve (or refresh) its device session for the doc — the
+        # durable oplog is the only truth it still holds
+        if self.admit is not None and not self.admit(doc_id):
             with self._sync_lock:
-                return self.banks[shard].text(doc_id, ol)
+                return ol.checkout_tip().snapshot()
+        with self._shard_locks[shard]:
+            return self.banks[shard].text(
+                doc_id, ol, oplog_lock=self._sync_lock,
+                device_lock=self._device_locks[shard])
 
     def rebalance(self, n_shards: int) -> Dict[str, tuple]:
         """Shrink (or restore) the live shard count: drain pending work,

@@ -291,7 +291,7 @@ def cmd_replicate_soak(args) -> int:
         reconcile_rounds=args.reconcile_rounds,
         lease_ttl_s=args.lease_ttl, serve_shards=args.serve_shards,
         crash=args.crash, asym=args.asym, churn=args.churn,
-        progress=args.progress)
+        witness=args.witness, progress=args.progress)
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:
             json.dump(report, f, indent=1)
@@ -312,9 +312,35 @@ def cmd_replicate_soak(args) -> int:
                  report["crashes"] else "")
               + (", split-brain: "
                  + ("NONE" if report["zero_split_brain"]
-                    else ",".join(report["split_brain"]))))
-    return 0 if report["converged"] and report["zero_split_brain"] \
-        else 1
+                    else ",".join(report["split_brain"])))
+              + ((", lock-witness: "
+                  + ("ACYCLIC" if report["lock_witness"]["acyclic"]
+                     else "CYCLIC " + ";".join(
+                         report["lock_witness"]["cycles"]))
+                  + f" ({report['lock_witness']['edge_count']} edges, "
+                  f"{report['lock_witness']['acquires']} acquires)")
+                 if "lock_witness" in report else ""))
+    return 0 if (report["converged"] and report["zero_split_brain"]
+                 and report.get("lock_witness",
+                                {}).get("acyclic", True)) else 1
+
+
+def cmd_dt_lint(args) -> int:
+    """Concurrency invariant lint (analysis/): lock-order violations,
+    unsorted multi-lock acquisition, device dispatch under the
+    global/oplog lock, unfenced doc-state mutation on write paths, and
+    jit-purity checks. Exit 0 = clean tree (the tier-1 gate)."""
+    from ..analysis import lint as _lint
+    report = _lint.run_lint(paths=args.paths or None,
+                            disable=args.disable)
+    _lint.publish_report(report)
+    if args.json:
+        print(_lint.render_json(report))
+    else:
+        print(_lint.render_human(report))
+    if args.fail_on == "error":
+        return 1 if report["errors"] else 0
+    return 0 if report["ok"] else 1
 
 
 def cmd_obs_report(args) -> int:
@@ -515,10 +541,34 @@ def main(argv=None) -> int:
                    "clock skew")
     c.add_argument("--churn", action="store_true",
                    help="join an extra node mid-run, then leave it")
+    c.add_argument("--witness", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="runtime lock witness during the soak: record "
+                   "held-while-acquiring edges and gate on an acyclic "
+                   "lock-order graph (default: on for --crash/--churn "
+                   "chaos runs)")
     c.add_argument("--progress", action="store_true")
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
     c.set_defaults(fn=cmd_replicate_soak)
+
+    c = sub.add_parser(
+        "dt-lint",
+        help="concurrency invariant lint: lock order, device dispatch "
+        "under the global/oplog lock, fencing, jit purity")
+    c.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the repo's "
+                   "concurrency-bearing packages)")
+    c.add_argument("--fail-on", choices=("warn", "error"),
+                   default="warn",
+                   help="exit nonzero on any violation (warn, the "
+                   "default) or only on severity=error findings")
+    c.add_argument("--disable", action="append", default=[],
+                   metavar="RULE",
+                   help="disable a rule by name (repeatable)")
+    c.add_argument("--json", action="store_true",
+                   help="print the full JSON report")
+    c.set_defaults(fn=cmd_dt_lint)
 
     c = sub.add_parser(
         "obs-report",

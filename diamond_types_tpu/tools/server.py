@@ -154,8 +154,11 @@ class DocStore:
         # recorder, per-endpoint latency histograms. serve() attaches
         # one; attach_replication forwards it to the ReplicaNode.
         self.obs = None
-        self.lock = threading.Lock()
-        self.io_lock = threading.Lock()   # serializes flush passes
+        from ..analysis.witness import make_lock
+        self.lock = make_lock("store.oplog", "oplog")
+        # serializes flush passes; deliberately OUTER to the oplog
+        # guard (its own `io` rung in the canonical lock order)
+        self.io_lock = make_lock("store.io", "io")
         # Long-poll wakeups (one condition per doc; notified on new ops).
         self._conds: Dict[str, threading.Condition] = {}
         self._stop = threading.Event()

@@ -53,7 +53,8 @@ DEFAULT_MAX_INS = 16
 WARMUP_SHAPE_CLASSES = (1, 2, 4, 8)
 
 _fused_jit_cache = {}
-_fused_jit_lock = threading.Lock()
+from ..analysis.witness import make_lock as _make_lock
+_fused_jit_lock = _make_lock("fused_jit", "leaf")
 
 
 def make_replay_body(mi: int):

@@ -72,8 +72,12 @@ def _tip_row_fn(W: int, n_rows: int):
     never deleted, 2 = placed and deleted, 0 = unplaced)."""
     import jax
 
+    # The 2-tuple key is deliberate: the tip-row builder has no tape
+    # dims (no op batch to shape-specialise), and jit retraces per
+    # carry shape anyway — the key only scopes the lookup for devprof
+    # hit accounting.
     key = (W, n_rows)
-    fn = _tip_jit_cache.get(key)
+    fn = _tip_jit_cache.get(key)  # dt-lint: ignore[jit-cache-key]
     from ..obs.devprof import note_jit_lookup
     note_jit_lookup("tip", fn is not None)
     if fn is None:
@@ -89,7 +93,7 @@ def _tip_row_fn(W: int, n_rows: int):
             return (state, snap, rank, ordv, ol_id, orr_id, ever, m, ak, sk)
 
         fn = jax.jit(build, donate_argnums=0)
-        _tip_jit_cache[key] = fn
+        _tip_jit_cache[key] = fn  # dt-lint: ignore[jit-cache-key]
     return fn
 
 

@@ -1,0 +1,13 @@
+"""Seeded dt-lint fixture: device dispatch under the oplog guard.
+
+Blocks on device work while holding a Store's oplog lock — every
+submit and oplog reader stalls behind the device call. Never
+imported; parsed by the lint engine only.
+"""
+
+
+class FixtureStore:
+    def flush_blocking(self, buf):
+        with self.lock:
+            import jax
+            jax.block_until_ready(buf)

@@ -1,0 +1,19 @@
+"""Seeded dt-lint fixture: host effects inside a traced body.
+
+The jitted body reads the host clock — traced code reruns an
+unpredictable number of times (trace + compile + replay). Never
+imported; parsed by the lint engine only.
+"""
+
+import time
+
+import jax
+
+
+def stamped_step(x):
+    t = time.time()
+    print("stepping", t)
+    return x + t
+
+
+stamped = jax.jit(stamped_step)

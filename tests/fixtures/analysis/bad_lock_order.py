@@ -1,0 +1,13 @@
+"""Seeded dt-lint fixture: lock-order violation.
+
+Acquires the shard lock while already holding a device lock —
+backwards against the canonical order (shard(20) < device(40)).
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureScheduler:
+    def backwards(self, s):
+        with self._device_locks[s]:
+            with self._shard_locks[s]:
+                return self.banks[s]

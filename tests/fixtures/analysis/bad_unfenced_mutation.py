@@ -1,0 +1,15 @@
+"""Seeded dt-lint fixture: doc-state mutation with no fencing check.
+
+The class participates in lease fencing (defines `_fence`) but
+`hot_write` reaches `sync_doc` without any fence token on the path —
+a deposed leader keeps mutating after its lease moved. Never
+imported; parsed by the lint engine only.
+"""
+
+
+class FixtureScheduler:
+    def _fence(self, doc_id, epoch):
+        return True
+
+    def hot_write(self, doc_id, ol):
+        self.banks[0].sync_doc(doc_id, ol)

@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: unsorted multi-lock acquisition.
+
+Acquires several device locks in a loop whose iteration source is not
+lexically sorted — two threads looping over differently-ordered shard
+lists deadlock. Never imported; parsed by the lint engine only.
+"""
+
+import contextlib
+
+
+class FixtureScheduler:
+    def grab_all(self, shards):
+        with contextlib.ExitStack() as stack:
+            for s in shards:
+                stack.enter_context(self._device_locks[s])
+            return len(shards)

@@ -1,0 +1,14 @@
+# dt-lint: skip-file
+"""Seeded dt-lint fixture: file-level opt-out.
+
+Contains a blatant lock-order violation that must NOT be reported
+because of the skip-file marker above. Never imported; parsed by the
+lint engine only.
+"""
+
+
+class FixtureScheduler:
+    def backwards(self, s):
+        with self._device_locks[s]:
+            with self._shard_locks[s]:
+                return s

@@ -1,0 +1,21 @@
+"""Seeded dt-lint fixture: violations silenced by suppressions.
+
+Same shapes as the bad_* fixtures but every finding carries a
+same-line `# dt-lint: ignore[rule]` — the file must lint clean.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureStore:
+    def flush_blocking(self, buf):
+        with self.lock:
+            import jax
+            jax.block_until_ready(buf)  # dt-lint: ignore[device-under-lock]
+
+
+_fixture_jit_cache = {}
+
+
+def lookup(b, n):
+    key = (b, n)
+    return _fixture_jit_cache.get(key)  # dt-lint: ignore[jit-cache-key]

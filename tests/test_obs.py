@@ -243,6 +243,39 @@ def test_prom_renderer_handles_missing_sections():
     _check_prom(render_metrics({"serve": None, "replication": None}))
 
 
+def test_prom_renders_witness_and_lint_families():
+    """The concurrency-invariant tier exports through the same one-
+    TYPE-per-name builder: dt_witness_* from the runtime lock witness,
+    dt_lint_violations_total{rule} from the last published dt-lint
+    report (zero-filled per rule on a clean run)."""
+    from diamond_types_tpu.analysis import (make_lock, witness_disable,
+                                            witness_enable,
+                                            witness_reset)
+    from diamond_types_tpu.analysis.lint import SEVERITY, publish_report
+    witness_reset()
+    witness_enable()
+    try:
+        outer = make_lock("t.outer", "global")
+        inner = make_lock("t.inner", "shard")
+        with outer:
+            with inner:
+                pass
+    finally:
+        witness_disable()
+    publish_report({"files": 3, "by_rule": {r: 0 for r in SEVERITY},
+                    "errors": 0, "warnings": 0, "ok": True})
+    obs = Observability(enabled=False)
+    text = render_metrics({"obs": obs.snapshot()})
+    _check_prom(text)
+    assert 'dt_witness_edges{edge="global->shard"} 1' in text
+    assert "dt_witness_acyclic 1" in text
+    assert "dt_witness_violations_total 0" in text
+    for rule in SEVERITY:
+        assert f'dt_lint_violations_total{{rule="{rule}"}} 0' in text
+    assert "dt_lint_ok 1" in text
+    witness_reset()
+
+
 def test_replication_metrics_v3_derived_keys():
     """Satellite (a): the v2 scalar pair is derived from the v3
     histogram so old scrapers keep working."""
