@@ -48,7 +48,8 @@ import re
 from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
-DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools")
+DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
+                    "storage")
 
 SEVERITY = {
     "lock-order": "error",

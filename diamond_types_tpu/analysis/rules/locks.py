@@ -87,6 +87,13 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
         return "repl.leases"
     if "io_lock" in src:
         return "io"
+    # residency tier: the hydrator's warm-map guard, the tier's table
+    # lock, and the per-doc file locks ("_doc_lock" also covers the
+    # `self._doc_lock(doc_id)` accessor form) all live on the io rung —
+    # deliberately OUTER to the oplog guard, like io_lock above
+    if "_hydrate_lock" in src or "_tier_lock" in src \
+            or "_doc_lock" in src:
+        return "io"
     if "_first_touch_lock" in src or "_jit_lock" in src:
         return "leaf"
     if src in ("self.lock", "self._lock", "lock"):

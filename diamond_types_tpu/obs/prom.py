@@ -103,6 +103,11 @@ def _render_serve(b: _Builder, serve: dict) -> None:
               serve["queue_bound_violations"])
     for k, v in sorted((serve.get("totals") or {}).items()):
         b.add(f"dt_serve_{k}_total", "counter", v)
+    # residency tier (metrics v7): cold->warm hydration + snapshot
+    # eviction counters; the cold-start histogram rides the shared
+    # latencies loop below as dt_hydration_cold_start_latency_seconds
+    for k, v in sorted((serve.get("hydration") or {}).items()):
+        b.add(f"dt_serve_hydration_{k}_total", "counter", v)
     for reason, n in sorted((serve.get("flush_reasons") or {}).items()):
         b.add("dt_serve_flush_reason_total", "counter", n,
               labels={"reason": reason})

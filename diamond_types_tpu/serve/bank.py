@@ -138,6 +138,12 @@ class SessionBank:
         # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
         # evictions and fallbacks are rare enough to record each one
         self.recorder = None
+        # residency tier (MergeScheduler.attach_hydrator): called as
+        # snapshot_hook(doc_id, pending_ops) at every eviction site so
+        # pending device state is persisted instead of silently
+        # dropped. Enqueue-only by contract — eviction runs under
+        # shard/oplog locks and must never wait on disk.
+        self.snapshot_hook = None
         self._warmup_thread: Optional[threading.Thread] = None
         if warmup and self.fused:
             self._warmup_thread = threading.Thread(
@@ -175,6 +181,38 @@ class SessionBank:
     def footprint_slots(self) -> int:
         return sum(s.footprint_slots() for s in self.sessions.values())
 
+    @staticmethod
+    def _pending_ops(sess) -> int:
+        """Ops the session's oplog holds beyond its synced frontier —
+        what a lossy eviction WOULD have dropped (device carry ahead of
+        the durable home). Both session kinds expose oplog/synced_to;
+        anything else reads as 0."""
+        ol = getattr(sess, "oplog", None)
+        if ol is None:
+            return 0
+        return max(len(ol) - getattr(sess, "synced_to", 0), 0)
+
+    def _drop(self, doc_id: str, sess, why: str) -> None:
+        """Shared eviction tail: count it, route the doc through the
+        snapshot path (when a residency tier is attached), and record
+        the flight-recorder event WITH the pending-op count — the
+        event is informational, not a data-loss marker, precisely
+        because the snapshot path persists that pending state."""
+        self._resyncs_seen.pop(doc_id, None)
+        self._bump("evictions")
+        pending = self._pending_ops(sess)
+        snapshotted = False
+        if self.snapshot_hook is not None:
+            try:
+                snapshotted = bool(self.snapshot_hook(doc_id, pending))
+            except Exception:   # pragma: no cover - hook must not wedge
+                pass
+        if self.recorder is not None:
+            self.recorder.record("session_evicted",
+                                 shard=self.shard_id, doc=doc_id,
+                                 why=why, pending_ops=pending,
+                                 snapshotted=snapshotted)
+
     def _evict_until_fits(self, incoming_slots: int = 0,
                           keep: Optional[str] = None) -> None:
         def over() -> bool:
@@ -185,22 +223,13 @@ class SessionBank:
             victim = next((k for k in self.sessions if k != keep), None)
             if victim is None:
                 break      # only `keep` is resident; nothing to evict
-            self.sessions.pop(victim)
-            self._resyncs_seen.pop(victim, None)
-            self._bump("evictions")
-            if self.recorder is not None:
-                self.recorder.record("session_evicted",
-                                     shard=self.shard_id, doc=victim,
-                                     why="capacity")
+            sess = self.sessions.pop(victim)
+            self._drop(victim, sess, why="capacity")
 
     def evict(self, doc_id: str) -> bool:
-        if self.sessions.pop(doc_id, None) is not None:
-            self._resyncs_seen.pop(doc_id, None)
-            self._bump("evictions")
-            if self.recorder is not None:
-                self.recorder.record("session_evicted",
-                                     shard=self.shard_id, doc=doc_id,
-                                     why="explicit")
+        sess = self.sessions.pop(doc_id, None)
+        if sess is not None:
+            self._drop(doc_id, sess, why="explicit")
             return True
         return False
 
@@ -230,6 +259,16 @@ class SessionBank:
         """Get-or-build the doc's resident session, updating LRU order
         and enforcing both residency bounds."""
         sess = self.sessions.get(doc_id)
+        if sess is not None and getattr(sess, "oplog", None) is not None \
+                and sess.oplog is not oplog:
+            # residency churn: the doc was evicted from the WARM tier
+            # and re-hydrated into a NEW OpLog object — a session bound
+            # to the old oplog would serve a frozen view forever.
+            # Rebuild against the live oplog (counted as an eviction,
+            # snapshot-routed like any other).
+            self.sessions.pop(doc_id)
+            self._drop(doc_id, sess, why="stale-oplog")
+            sess = None
         if sess is not None:
             self.sessions.move_to_end(doc_id)
             return sess

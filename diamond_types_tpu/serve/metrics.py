@@ -7,7 +7,7 @@ metrics path can run inside flush loops without perturbing timings.
 
 Schema (snapshot()):
 
-  {"version": 6,                   # counter-set schema; bump on change
+  {"version": 7,                   # counter-set schema; bump on change
    "uptime_s": s,                  # monotonic since construction
    "shards": N, "flush_docs": B,
    "totals": {"submits", "coalesced", "rejects", "denied", "fenced",
@@ -26,9 +26,14 @@ Schema (snapshot()):
               "docs", "mesh_docs", "mesh_padded_rows",
               "mesh_occupancy",               # docs / padded rows
               "shards_hist": {"2": n, ...}},  # shards per window
+   "hydration": {"prefetches", "warm_hits", "hydrations", ...},
+                                    # the residency tier's counter set
+                                    # (HYDRATION_KEYS; all zero until a
+                                    # Hydrator is attached)
    "max_depth_seen": d,
    "queue_bound_violations": 0,     # depth observed above max_pending
-   "latencies": {"flush": hist},    # obs.hist snapshot w/ p50/p90/p99
+   "latencies": {"flush": hist,     # obs.hist snapshot w/ p50/p90/p99
+                 "hydration_cold_start": hist},  # prefetch/miss -> warm
    "per_shard": [{"shard", "queue_depth", "submits", "rejects",
                   "flushes", "flushed_docs", "builds", "evictions",
                   "resyncs", "host_fallbacks", "footprint_slots",
@@ -49,6 +54,34 @@ _SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "fenced",
                "evictions", "resyncs", "syncs", "host_fallbacks",
                "fused_calls", "fused_docs")
 
+# the residency tier's counter set (serve.hydrate.Hydrator feeds these
+# through record_hydration; hydrate.py imports the tuple so the two
+# surfaces can never drift)
+HYDRATION_KEYS = (
+    "prefetches",           # async hydrations queued on first admit
+    "warm_hits",            # resolve served from the warm map
+    "hydrations",           # cold -> warm installs (async + sync)
+    "sync_hydrations",      # resolve cold misses hydrated inline
+    "attempts", "retries",  # load attempts / attempts after the first
+    "timeouts",             # per-attempt HydrationTimeouts
+    "load_errors",          # unexpected load exceptions (transient)
+    "hydrate_gave_up",      # async ladder exhausted; doc left cold
+    "quarantined",          # docs the HYDRATOR quarantined
+    "quarantined_drops",    # flush-gate drops of quarantined docs
+    "deferrals",            # cold docs requeued for a delayed flush
+    "defer_escalations",    # 2nd gate visit: hydrated sync in-flush
+    "defer_gave_up",        # defer budget exhausted -> quarantined
+    "deferred_drops",       # deferral requeue hit backpressure
+    "prefetch_queue_full",  # prefetch rejected, bounded queue full
+    "flush_leaks",          # resolve raised INSIDE a batch (must be 0)
+    "snapshot_requests",    # bank eviction hook enqueues
+    "snapshots",            # successful doc-file persists
+    "snapshot_queue_full",  # hook enqueue rejected
+    "snapshot_errors",      # persist failed (doc stays warm)
+    "evictions_to_snapshot",  # warm evictions that saved first
+    "eviction_aborts",      # eviction raced a resolve; doc kept warm
+)
+
 
 class ServeMetrics:
     # bump whenever the counter set changes so bench/soak tooling can
@@ -61,8 +94,10 @@ class ServeMetrics:
     # `fused` occupancy block — docs folded per vmapped device call;
     # v6 = the `window` block — flush-window dispatch accounting
     # (`device_calls_per_window` is the N-dispatches-to-1 signal the
-    # mesh flush window exists to move) + mesh super-batch occupancy)
-    SCHEMA_VERSION = 6
+    # mesh flush window exists to move) + mesh super-batch occupancy;
+    # v7 = the `hydration` block (HYDRATION_KEYS — the cold->warm
+    # residency tier's counters) + `latencies.hydration_cold_start`)
+    SCHEMA_VERSION = 7
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -92,6 +127,11 @@ class ServeMetrics:
         self.queue_depth: List[int] = [0] * n_shards
         self.footprint_slots: List[int] = [0] * n_shards
         self.flush_latency = Histogram()
+        # residency-tier counters: all zero until a Hydrator is
+        # attached (the block is always exported so dashboards don't
+        # need schema forks)
+        self.hydration: Dict[str, int] = {k: 0 for k in HYDRATION_KEYS}
+        self.cold_start_latency = Histogram()
         self.flush_wall_s: List[float] = [0.0] * n_shards
         self.device_sync_s: List[float] = [0.0] * n_shards
         # obs.recorder.FlightRecorder, wired by
@@ -179,21 +219,37 @@ class ServeMetrics:
         with self._lock:
             self.footprint_slots[shard] = int(slots)
 
+    def record_hydration(self, event: str, n: int = 1) -> None:
+        """One residency-tier event (a HYDRATION_KEYS key). Unknown
+        keys are created rather than dropped — a newer Hydrator against
+        an older metrics build degrades to extra counters, not lost
+        ones."""
+        with self._lock:
+            self.hydration[event] = self.hydration.get(event, 0) + n
+
+    def observe_cold_start(self, dur_s: float) -> None:
+        """Cold-start latency: prefetch enqueue (or resolve miss) to
+        warm install. The histogram has its own lock."""
+        self.cold_start_latency.record(dur_s)
+
     # ---- export ----------------------------------------------------------
 
     def snapshot(self) -> dict:
-        # the histogram has its own lock: snapshot it before taking
-        # ours (never nest)
+        # the histograms have their own locks: snapshot them before
+        # taking ours (never nest)
         flush_hist = self.flush_latency.snapshot()
+        cold_hist = self.cold_start_latency.snapshot()
         with self._lock:
             totals = {k: sum(s[k] for s in self.shard)
                       for k in _SHARD_KEYS}
             flushes = max(totals["flushes"], 1)
             occupancy = (totals["flushed_docs"] / flushes) \
                 / self.flush_docs
-            return self._snapshot_locked(totals, occupancy, flush_hist)
+            return self._snapshot_locked(totals, occupancy, flush_hist,
+                                         cold_hist)
 
-    def _snapshot_locked(self, totals, occupancy, flush_hist) -> dict:
+    def _snapshot_locked(self, totals, occupancy, flush_hist,
+                         cold_hist) -> dict:
         return {
             "version": self.SCHEMA_VERSION,
             "uptime_s": round(time.monotonic() - self.started_at, 3),
@@ -234,9 +290,11 @@ class ServeMetrics:
                     str(k): v for k, v in
                     sorted(self.window_shards_hist.items())},
             },
+            "hydration": dict(self.hydration),
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,
-            "latencies": {"flush": flush_hist},
+            "latencies": {"flush": flush_hist,
+                          "hydration_cold_start": cold_hist},
             "per_shard": [
                 {"shard": i, "queue_depth": self.queue_depth[i],
                  "footprint_slots": self.footprint_slots[i],

@@ -154,6 +154,9 @@ class MergeScheduler:
         # obs.Observability bundle (attach_obs); None = zero overhead:
         # every obs touchpoint below is guarded by this one attribute
         self.obs = None
+        # serve.hydrate.Hydrator (attach_hydrator); None = the classic
+        # everything-resident scheduler — no prefetch, no flush gate
+        self.hydrator = None
         self.lock = make_lock("scheduler.global", "global")
         self._shard_locks = [make_lock(f"shard[{i}]", "shard", rank=i)
                              for i in range(n_shards)]
@@ -180,6 +183,29 @@ class MergeScheduler:
         self.metrics.recorder = obs.recorder
         for bank in self.banks:
             bank.recorder = obs.recorder
+        if self.hydrator is not None:
+            self.hydrator.recorder = obs.recorder
+
+    def attach_hydrator(self, hydrator) -> None:
+        """Wire the residency tier in: `submit` prefetches on a doc's
+        first admit (budgeted by the bucket flush deadline), the flush
+        paths gate on warmth right after the lease fence (cold docs
+        requeue — a delayed flush; quarantined docs drop before they
+        can join a batch), and every bank eviction routes through the
+        hydrator's snapshot queue instead of silently dropping pending
+        state. The scheduler's `resolve` should be `hydrator.resolve`
+        (the CLI soak wires it that way); `attach_hydrator` does not
+        rebind it so store-backed resolves stay possible."""
+        self.hydrator = hydrator
+        if hydrator.metrics is None:
+            hydrator.metrics = self.metrics
+        if hydrator.oplog_lock is None and not isinstance(
+                self._sync_lock, contextlib.nullcontext):
+            hydrator.oplog_lock = self._sync_lock
+        if self.obs is not None:
+            hydrator.recorder = self.obs.recorder
+        for bank in self.banks:
+            bank.snapshot_hook = hydrator.request_snapshot
 
     # ---- intake ----------------------------------------------------------
 
@@ -214,6 +240,21 @@ class MergeScheduler:
                 span.end(outcome="denied")
                 return {"accepted": False, "shard": shard,
                         "reason": "not_owner"}
+        hyd = self.hydrator
+        if hyd is not None:
+            if hyd.store.is_quarantined(doc_id) is not None:
+                shard = self.router.shard_of(doc_id)
+                span.end(outcome="quarantined")
+                return {"accepted": False, "shard": shard,
+                        "reason": "quarantined"}
+            # async prefetch on FIRST admit, budgeted by the bucket
+            # flush deadline — by the time the bucket is due the doc
+            # is usually warm. The unlocked assignments peek is a
+            # benign race: a doc already warm/pending is a no-op
+            # prefetch, and prefetch itself re-checks under its lock.
+            if doc_id not in self.router.assignments:
+                hyd.prefetch(doc_id,
+                             budget_s=self.queue.flush_deadline_s)
         # stamp the admit-time lease epoch; the flush rechecks it
         epoch = self.epoch_of(doc_id) if self.epoch_of is not None \
             else -1
@@ -358,15 +399,57 @@ class MergeScheduler:
                 kept.append(item)
         return kept
 
+    def _flush_resolve(self, doc_id: str):
+        """The flush paths' resolve: identical to `self.resolve` except
+        that an exception INSIDE a batch is counted as a flush leak —
+        the hydration gate should have filtered the doc first, so the
+        soak asserts this stays zero."""
+        try:
+            return self.resolve(doc_id)
+        except Exception as e:
+            if self.hydrator is not None:
+                self.hydrator.note_flush_leak(doc_id, e)
+            raise
+
+    def _hydration_gate(self, shard: int, items) -> list:
+        """Residency recheck right after the lease fence: keep warm
+        docs, DROP quarantined ones (they must never join a batch),
+        and REQUEUE still-cold ones — a delayed flush on the next pump
+        once hydration lands, never a stalled batch waiting on disk."""
+        hyd = self.hydrator
+        if hyd is None:
+            return items
+        keep, defer, dropped = hyd.flush_gate(shard, items)
+        if defer:
+            now = time.monotonic()
+            with self.lock:
+                for it in defer:
+                    try:
+                        self.queue.submit(shard, it.doc_id, it.n_ops,
+                                          now, epoch=it.epoch,
+                                          trace=it.trace)
+                    except Backpressure:
+                        # the queue refilled while this batch was in
+                        # flight; the doc's ops are durable, drop the
+                        # merge work like a fenced item
+                        hyd._bump("deferred_drops")
+        if dropped and self.obs is not None:
+            self.obs.recorder.record(
+                "flush_gate_dropped", shard=shard, docs=len(dropped))
+        return keep
+
     def _flush_items(self, shard: int, reason: str, items) -> None:
         """Sync one taken batch into its shard's bank, under that
         shard's lock only (items are already off the queue, so a
         concurrent submit for the same doc simply queues fresh work).
         The fencing recheck runs first: work admitted under a lease
         epoch this host no longer holds is dropped (`fenced`), never
-        merged — its ops are still in the oplog for the new owner."""
+        merged — its ops are still in the oplog for the new owner.
+        The hydration gate runs second (residency recheck: see
+        `_hydration_gate`)."""
         obs = self.obs
         items = self._fence(shard, items)
+        items = self._hydration_gate(shard, items)
         if not items:
             return
         fspan = NOOP_SPAN
@@ -389,7 +472,7 @@ class MergeScheduler:
                                  parent=fspan.context(),
                                  attrs={"docs": len(items)})
             res = bank.sync_docs(
-                items, self.resolve, oplog_lock=self._sync_lock,
+                items, self._flush_resolve, oplog_lock=self._sync_lock,
                 device_lock=self._device_locks[shard])
             dspan.end(fused_calls=res["fused_calls"],
                       fused_docs=res["fused_docs"])
@@ -447,6 +530,7 @@ class MergeScheduler:
         entries = []        # (shard, reason, items) — post-fencing
         for shard, reason, items in taken:
             items = self._fence(shard, items)
+            items = self._hydration_gate(shard, items)
             if items:
                 entries.append((shard, reason, items))
         if not entries:
@@ -471,8 +555,8 @@ class MergeScheduler:
             for s in shards:
                 sstack.enter_context(self._shard_locks[s])
             wins = [self.banks[s].plan_window(
-                        items, self.resolve, oplog_lock=self._sync_lock,
-                        min_fuse=1)
+                        items, self._flush_resolve,
+                        oplog_lock=self._sync_lock, min_fuse=1)
                     for s, _r, items in entries]
             # concatenate fusable rows across shards by shape class —
             # rows sharing (cap, max_ins) share one mesh program
@@ -586,15 +670,23 @@ class MergeScheduler:
     def drain(self) -> int:
         """Flush everything regardless of triggers (shutdown, rebalance,
         parity checks), then wait for the shard workers to go idle —
-        the return means every dispatched doc has actually merged."""
+        the return means every dispatched doc has actually merged. A
+        hydration gate deferral requeues from INSIDE a flush worker, so
+        after the workers go idle the depth is re-checked: deferred
+        docs get further rounds until they hydrate (bounded by the
+        hydrator's defer budget) or the queue is genuinely empty."""
         total = 0
-        while self.queue.total_depth():
-            n = self.pump(force=True)
-            if n == 0:
-                break     # defensive: a take() returning nothing
-            total += n
-        self._wait_idle()
-        return total
+        while True:
+            progressed = False
+            while self.queue.total_depth():
+                n = self.pump(force=True)
+                if n == 0:
+                    break     # defensive: a take() returning nothing
+                progressed = True
+                total += n
+            self._wait_idle()
+            if not self.queue.total_depth() or not progressed:
+                return total
 
     # ---- reads / control -------------------------------------------------
 

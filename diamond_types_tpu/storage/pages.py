@@ -36,9 +36,23 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..encoding.crc32c import crc32c
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """fsync a directory so a just-completed rename survives power
+    loss (an os.replace is atomic but not durable until the directory
+    entry itself is flushed)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:     # pragma: no cover - platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 PAGE_SIZE = 4096
 _HDR = struct.Struct("<IBBHIII")        # crc, stream, blit, used, idx, gen, seq
@@ -354,6 +368,12 @@ class PagedDocFile:
         from ..encoding.decode import decode_into
         from ..text.oplog import OpLog
         self.path = path
+        stale = path + ".compact"
+        if os.path.exists(stale):
+            # a crash mid-compaction left a half-built rewrite behind;
+            # `path` is authoritative either way (the swap is atomic),
+            # and compact() must never append onto a stale rewrite
+            os.remove(stale)
         self.store = PagedStore(path)
         self.oplog = OpLog()
         for rec in self.store.records(self.BASELINE):
@@ -373,20 +393,51 @@ class PagedDocFile:
         self.store.append(self.PATCHES, patch)
         decode_into(self.oplog, patch)
 
-    def compact(self) -> None:
+    def compact(self,
+                _crash: Optional[Callable[[str], None]] = None) -> None:
+        """Fold both streams into a fresh single-baseline file.
+
+        Crash protocol — each step is individually durable, so a kill
+        at any point recovers to either the old or the new snapshot,
+        never a torn mix:
+
+          1. the full snapshot is built at `<path>.compact` (every
+             page fsynced as written)           crash -> old file wins
+          2. `os.replace` swaps it in atomically crash -> old OR new
+          3. the directory entry is fsynced so the rename itself
+             survives power loss                crash -> new file wins
+
+        A stale `.compact` from an earlier crash is removed before
+        rebuilding (and on open), so step 1 never appends onto a
+        half-built rewrite. `_crash(point)` is a fault-injection hook
+        fired after each step ("snapshot_written", "replaced",
+        "dir_synced"); whatever it raises propagates only AFTER the
+        store has been reopened on whichever image the crash left, so
+        the object stays usable and matches what a real restart would
+        recover."""
         from ..encoding.encode import ENCODE_FULL, encode_oplog
+        crash = _crash if _crash is not None else (lambda point: None)
         blob = encode_oplog(self.oplog, ENCODE_FULL)
         tmp = self.path + ".compact"
         try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
             fresh = PagedStore(tmp)
             fresh.append(self.BASELINE, blob)
             fresh.close()
+            crash("snapshot_written")
             self.store.close()
             os.replace(tmp, self.path)
+            crash("replaced")
+            _fsync_dir(os.path.dirname(os.path.abspath(self.path)))
+            crash("dir_synced")
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
-        self.store = PagedStore(self.path)
+            # reopen even when a step (or the hook) raised: recovery
+            # picks up whichever complete image is at `path`
+            self.store.close()
+            self.store = PagedStore(self.path)
 
     def close(self) -> None:
         self.store.close()

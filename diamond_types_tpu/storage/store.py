@@ -239,10 +239,20 @@ class DocFile:
                                            summarize_versions(self.oplog.cg))
         return common
 
-    def compact(self) -> None:
-        """Fold the WAL into the baseline (reference: dt-cli repack role)."""
+    def compact(self, _crash=None) -> None:
+        """Fold the WAL into the baseline (reference: dt-cli repack
+        role). fsync ordering: PageStore.write makes the new baseline
+        extent + header durable BEFORE the WAL truncates — a crash
+        between the two steps replays the stale WAL onto the new
+        baseline, which the idempotent decode dedups to the same
+        oplog. `_crash(point)` is a fault-injection hook fired after
+        each durable step ("baseline_written", "wal_reset")."""
         self.base.write(encode_oplog(self.oplog, ENCODE_FULL))
+        if _crash is not None:
+            _crash("baseline_written")
         self.wal.reset()
+        if _crash is not None:
+            _crash("wal_reset")
 
     def close(self) -> None:
         self.base.close()

@@ -325,6 +325,52 @@ def cmd_replicate_soak(args) -> int:
                                 {}).get("acyclic", True)) else 1
 
 
+def cmd_storage_soak(args) -> int:
+    """Churn docs through an undersized residency tier (cold snapshot
+    store -> warm hydrator -> scheduler) with seeded fault injection —
+    crash-restart, crash-mid-compaction, torn tails, wholesale
+    corruption, slow disk — and gate on byte-identical re-hydration,
+    exact quarantine containment, zero flush leaks and bounded
+    cold-start p99 (see storage/soak.py)."""
+    from ..storage.soak import run_storage_soak
+    report = run_storage_soak(
+        docs=args.docs, warm=args.warm, rounds=args.rounds,
+        edits_per_round=args.edits_per_round, shards=args.shards,
+        seed=args.seed, compact_every=args.compact_every,
+        churn=args.churn, crash=args.crash, slow=args.slow,
+        data_dir=args.data_dir, p99_budget_s=args.p99_budget,
+        progress=args.progress)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        cold = report["cold_start"]
+        wit = report["lock_witness"]
+        print(f"storage-soak: {report['config']['docs']} docs / "
+              f"{report['config']['warm']} warm slots, "
+              f"{report['edits']} edits, "
+              f"{report['rehydrations']} re-hydrations "
+              f"({report['byte_mismatches']} byte mismatches), "
+              f"quarantine {'EXACT' if report['quarantine_match'] else 'MISMATCH'} "
+              f"({len(report['quarantined'])} docs, "
+              f"{report['quarantine_leaks']} flush leaks), "
+              f"cold-start p99 {cold['p99'] * 1e3:.1f}ms"
+              f"{' OK' if report['p99_ok'] else ' OVER BUDGET'}"
+              + (f", {report['crashes']} crash-restarts, "
+                 f"{report['compaction_kills']} compaction kills, "
+                 f"{report['torn_tails']} torn tails"
+                 if report["config"]["crash"] else "")
+              + ", lock-witness "
+              + ("ACYCLIC" if wit["acyclic"] and not wit["violation_count"]
+                 else "VIOLATED")
+              + f" in {report['wall_s']}s: "
+              + ("OK" if report["ok"] else "FAILED"
+                 + (f" ({report['error']})" if "error" in report else "")))
+    return 0 if report["ok"] else 1
+
+
 def cmd_dt_lint(args) -> int:
     """Concurrency invariant lint (analysis/): lock-order violations,
     unsorted multi-lock acquisition, device dispatch under the
@@ -551,6 +597,42 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
     c.set_defaults(fn=cmd_replicate_soak)
+
+    c = sub.add_parser(
+        "storage-soak",
+        help="fault-injected tiered-residency soak: churn docs "
+        "through an undersized warm tier and gate on byte-identical "
+        "re-hydration")
+    c.add_argument("--docs", type=int, default=120)
+    c.add_argument("--warm", type=int, default=12,
+                   help="warm-tier capacity (deliberately << --docs: "
+                   "eviction pressure is the point)")
+    c.add_argument("--rounds", type=int, default=8)
+    c.add_argument("--edits-per-round", type=int, default=48)
+    c.add_argument("--shards", type=int, default=2)
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--compact-every", type=int, default=16,
+                   help="per-doc WAL patch records before a baseline "
+                   "fold (low = many compactions under churn)")
+    c.add_argument("--churn", action="store_true",
+                   help="force extra evictions-to-snapshot every round "
+                   "beyond what warm-tier pressure already causes")
+    c.add_argument("--crash", action="store_true",
+                   help="inject crash-restart, crash-mid-compaction "
+                   "(every fsync point), torn tails and wholesale "
+                   "corruption")
+    c.add_argument("--slow", action="store_true",
+                   help="seeded slow-disk delays on load (exercises "
+                   "the per-attempt timeout / retry ladder)")
+    c.add_argument("--data-dir",
+                   help="home directory for the doc snapshot files "
+                   "(default: a fresh temp dir, removed afterwards)")
+    c.add_argument("--p99-budget", type=float, default=0.5,
+                   help="cold-start p99 gate in seconds")
+    c.add_argument("--progress", action="store_true")
+    c.add_argument("--json", action="store_true")
+    c.add_argument("--metrics-out")
+    c.set_defaults(fn=cmd_storage_soak)
 
     c = sub.add_parser(
         "dt-lint",

@@ -1,0 +1,15 @@
+"""Seeded dt-lint fixture: residency-tier lock-order violation.
+
+Acquires the hydrator's warm-map guard (io, 25) while already holding
+the oplog guard (30) — backwards against the canonical order: io is
+deliberately OUTER to oplog (snapshot encode runs under the oplog
+guard INSIDE an io-serialized pass, never the reverse).
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureHydrator:
+    def backwards(self, doc_id):
+        with self.store.lock:
+            with self._hydrate_lock:
+                return self._warm.get(doc_id)
