@@ -1436,6 +1436,30 @@ def _main() -> None:
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 
+    # Follower-read A/B (read/ tier): two-server mesh, Zipf readers at
+    # each doc's non-owner replica — bounded-staleness local serving
+    # vs the owner-only-proxy control, with client-side staleness +
+    # read-your-writes verification (speedup is THE ROADMAP item 5
+    # follower-read number)
+    try:
+        from diamond_types_tpu.read.bench import run_read_bench
+        rb = run_read_bench(docs=3, readers=4, reads_per_reader=60,
+                            seed=7)
+        full["serve_read"] = rb
+        extra["serve_read"] = {
+            "control_reads_per_sec": rb["control"]["reads_per_s"],
+            "follower_reads_per_sec": rb["follower"]["reads_per_s"],
+            "speedup": rb["speedup"],
+            "violations": rb["violations"],
+            "follower_local": rb["follower"]["local"],
+            "control_proxied": rb["control"]["proxied"],
+            "max_observed_staleness_s":
+                rb["follower"]["max_observed_staleness_s"],
+            "ok": rb["ok"],
+        }
+    except Exception as e:  # pragma: no cover
+        extra["serve_read_error"] = str(e)[:120]
+
     # Peak-memory probe (reference: examples/posstats.rs behind the
     # memusage feature / trace-alloc counting allocator). Python-side
     # allocations only; the C++ tier's tables are outside tracemalloc.

@@ -1,8 +1,8 @@
 """AST-based concurrency invariant linter (CLI: `dt-lint`).
 
 Walks the concurrency-bearing packages (serve/, replicate/, tpu/,
-parallel/, tools/) and enforces the invariants serve/README.md
-documents under "Concurrency invariants":
+parallel/, tools/, storage/, read/) and enforces the invariants
+serve/README.md documents under "Concurrency invariants":
 
   lock-order          acquiring a lock whose order class sits EARLIER
                       in the canonical order than a lock already held
@@ -49,7 +49,7 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
 DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
-                    "storage")
+                    "storage", "read")
 
 SEVERITY = {
     "lock-order": "error",

@@ -94,6 +94,13 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     if "_hydrate_lock" in src or "_tier_lock" in src \
             or "_doc_lock" in src:
         return "io"
+    # follower-read tier: the FollowerIndex evidence guard (`_read_lock`)
+    # and the CheckoutCache guard (`_cache_lock`) are io-rung for the
+    # same reason — the cache's single-flight leader materializes
+    # checkouts (oplog rung) strictly OUTSIDE the cache guard, so io
+    # stays outer to oplog and never the reverse
+    if "_read_lock" in src or "_cache_lock" in src:
+        return "io"
     if "_first_touch_lock" in src or "_jit_lock" in src:
         return "leaf"
     if src in ("self.lock", "self._lock", "lock"):

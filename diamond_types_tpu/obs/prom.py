@@ -11,6 +11,9 @@ Naming scheme:
   dt_serve_flush_reason_total{reason}
   dt_serve_shard_*{shard}             per-shard gauges/counters
   dt_repl_<group>_<key>_total         replication counters
+  dt_read_<counter>_total             follower-read tier counters
+  dt_read_local_ratio /               local-serve ratio gauge +
+  dt_read_staleness_seconds           staleness histogram
   dt_<name>_latency_seconds           histograms (flush, handoff,
                                       quorum_round, probe,
                                       antientropy_round)
@@ -157,6 +160,23 @@ def _render_serve(b: _Builder, serve: dict) -> None:
         b.histogram(f"dt_{name}_latency_seconds", snap)
 
 
+def _render_read(b: _Builder, read: dict) -> None:
+    """The follower-read tier (ServeMetrics v8 `read` block /
+    top-level `read` key): READ_KEYS counters as dt_read_*_total, the
+    local-serve ratio gauge, the staleness histogram, and the catch-up
+    wait histogram (via the shared latency naming)."""
+    for k, v in sorted((read.get("counters") or {}).items()):
+        b.add(f"dt_read_{k}_total", "counter", v)
+    lr = read.get("local_ratio")
+    if lr is not None:
+        b.add("dt_read_local_ratio", "gauge", lr)
+    st = read.get("staleness")
+    if isinstance(st, dict) and st:
+        b.histogram("dt_read_staleness_seconds", st)
+    for name, snap in sorted((read.get("latencies") or {}).items()):
+        b.histogram(f"dt_{name}_latency_seconds", snap)
+
+
 def _render_replication(b: _Builder, repl: dict) -> None:
     for group, vals in sorted(repl.items()):
         if group in ("version", "self", "latencies") or \
@@ -233,6 +253,14 @@ def render_metrics(doc: dict) -> str:
     serve = doc.get("serve")
     if isinstance(serve, dict):
         _render_serve(b, serve)
+    # the read block rides either at top level (scheduler-less
+    # servers) or inside the serve snapshot (ServeMetrics v8); render
+    # whichever is present, once
+    read = doc.get("read")
+    if not isinstance(read, dict) and isinstance(serve, dict):
+        read = serve.get("read")
+    if isinstance(read, dict):
+        _render_read(b, read)
     repl = doc.get("replication")
     if isinstance(repl, dict):
         _render_replication(b, repl)

@@ -72,6 +72,9 @@ class AntiEntropy:
     def _round_with(self, peer_id: str) -> dict:
         node = self.node
         rep = {"docs": 0, "pulled": 0, "pushed": 0, "errors": 0}
+        # advert timestamp: stamped BEFORE the request so it is a
+        # conservative lower bound on "when the peer was in this state"
+        t0 = time.monotonic()
         try:
             listing = node.table.call_json(peer_id, "/replicate/docs")
         except (OSError, urllib.error.HTTPError):
@@ -79,6 +82,7 @@ class AntiEntropy:
             rep["errors"] += 1
             return rep
         remote_docs = listing.get("docs") or {}
+        reads = getattr(node.store, "reads", None)
         # piggybacked lease claims keep the lease view fresh
         for doc_id, info in remote_docs.items():
             lease = (info or {}).get("lease")
@@ -87,6 +91,15 @@ class AntiEntropy:
                     doc_id, lease["holder"], int(lease["epoch"]),
                     lease.get("state", "active"),
                     float(lease.get("ttl_s", 0.0)))
+            # piggybacked frontier advertisement feeds the
+            # follower-read staleness contract (read/follower.py);
+            # only an advert from the doc's lease HOLDER proves
+            # owner-side freshness, so record the peer's own frontier
+            frontier = (info or {}).get("frontier")
+            if reads is not None and frontier:
+                reads.index.note_advert(doc_id, peer_id, frontier,
+                                        as_of=t0)
+                node.metrics.bump("antientropy", "frontier_adverts")
         doc_ids = sorted(set(remote_docs) | set(node.store.doc_ids()))
         if self.max_docs_per_round is not None:
             doc_ids = doc_ids[:self.max_docs_per_round]
@@ -108,6 +121,9 @@ class AntiEntropy:
         node = self.node
         store = node.store
         node.metrics.bump("antientropy", "docs_checked")
+        # reconcile timestamp: a COMPLETED handshake proves the local
+        # oplog covers everything the peer had as of the round start
+        t0 = time.monotonic()
         remote_summary = node.table.call_json(
             peer_id, f"/doc/{doc_id}/summary")
         ol = store.get(doc_id)
@@ -150,6 +166,14 @@ class AntiEntropy:
                 # owner-gated: on a non-owner the admit gate denies and
                 # the ops stay host-side until the lease moves here
                 store.submit_merge(doc_id, n_new)
+        reads = getattr(store, "reads", None)
+        if reads is not None:
+            if out["pulled"]:
+                # the doc's tip moved under us: drop cached checkouts
+                reads.on_antientropy_apply(doc_id)
+            # pull (or no remainder at all) completed: local state now
+            # dominates the peer's as of t0
+            reads.index.note_reconciled(doc_id, peer_id, as_of=t0)
         if push_patch is not None:
             node.table.call(peer_id, f"/doc/{doc_id}/push",
                             data=push_patch)

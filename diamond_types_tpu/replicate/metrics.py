@@ -6,6 +6,9 @@ snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
 Changelog:
+  v4  `antientropy.frontier_adverts` — owner frontier advertisements
+      folded into the follower-read tier's FollowerIndex (from ping
+      gossip and `/replicate/docs` piggybacks; read/follower.py).
   v3  latency observations moved onto obs.hist log-bucketed
       histograms. `handoffs.latency_s_total/latency_s_max` are now
       DERIVED from the handoff histogram (kept so schema-v2 scrapers
@@ -18,7 +21,7 @@ Changelog:
 
 Schema (snapshot()):
 
-  {"version": 3, "self": "host:port",
+  {"version": 4, "self": "host:port",
    "leases": {"held", "acquires", "renewals", "takeovers", "releases",
               "tie_breaks",        # equal-epoch conflicts arbitrated
               "churn"},            # churn = acquires+takeovers+releases
@@ -26,7 +29,7 @@ Schema (snapshot()):
                 "latency_s_total", "latency_s_max"},
    "antientropy": {"rounds", "docs_checked", "docs_pulled",
                    "docs_pushed", "bytes_pulled", "bytes_pushed",
-                   "errors"},
+                   "errors", "frontier_adverts"},
    "proxy": {"proxied", "fallback_local", "loops_refused",
              "fenced_relays"},     # 409-fenced proxies retried locally
    "merge_gate": {"admits", "denials"},
@@ -64,7 +67,7 @@ _GROUPS = {
     "handoffs": ("started", "completed", "failed"),
     "antientropy": ("rounds", "docs_checked", "docs_pulled",
                     "docs_pushed", "bytes_pulled", "bytes_pushed",
-                    "errors"),
+                    "errors", "frontier_adverts"),
     "proxy": ("proxied", "fallback_local", "loops_refused",
               "fenced_relays"),
     "merge_gate": ("admits", "denials"),
@@ -80,8 +83,8 @@ _GROUPS = {
 
 
 class ReplicationMetrics:
-    # v2 -> v3: latency histograms (see module docstring changelog)
-    SCHEMA_VERSION = 3
+    # v3 -> v4: antientropy.frontier_adverts (see changelog)
+    SCHEMA_VERSION = 4
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id

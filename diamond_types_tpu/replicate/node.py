@@ -401,12 +401,36 @@ class ReplicaNode:
     def ping_json(self) -> dict:
         """Body of `GET /replicate/ping` — health ack + gossip
         piggyback (the probe loop is the gossip transport)."""
-        return {"ok": True, "id": self.self_id,
-                "uptime_s": round(time.monotonic() - self.started_at, 3),
-                "incarnation": self.membership.self_incarnation,
-                "view_version": self.membership.view_version,
-                "rejoining": self.rejoining,
-                "members": self.membership.gossip_payload()}
+        out = {"ok": True, "id": self.self_id,
+               "uptime_s": round(time.monotonic() - self.started_at, 3),
+               "incarnation": self.membership.self_incarnation,
+               "view_version": self.membership.view_version,
+               "rejoining": self.rejoining,
+               "members": self.membership.gossip_payload()}
+        frontiers = self._owned_frontiers()
+        if frontiers is not None:
+            out["frontiers"] = frontiers
+        return out
+
+    def _owned_frontiers(self, cap: int = 32):
+        """Frontier advertisements for the follower-read tier: the
+        current frontier of every doc whose ACTIVE lease we hold
+        (capped — ping bodies must stay small). None when follower
+        reads aren't attached anywhere, so the ping body is unchanged
+        on meshes without the feature."""
+        if getattr(self.store, "reads", None) is None:
+            return None
+        held = self.leases.held_ids()[:cap]
+        if not held:
+            return {}
+        frontiers = {}
+        with self.store.lock:
+            for doc_id in held:
+                ol = self.store.docs.get(doc_id)
+                if ol is not None:
+                    frontiers[doc_id] = \
+                        ol.cg.local_to_remote_frontier(ol.version)
+        return frontiers
 
     def _on_ping(self, peer_id: str, body: dict) -> None:
         """Probe-loop gossip hook: fold the responder's member table,
@@ -418,6 +442,19 @@ class ReplicaNode:
                 if isinstance(info, dict) \
                         and info.get("state") != LEFT:
                     self.table.add_peer(mid)
+        # frontier advertisements for the follower-read tier: the
+        # responder gossips the frontiers of docs it holds ACTIVE
+        # leases on. Fold time stands in for send time (sub-RTT slop;
+        # the staleness contract's useful bounds are >= hundreds of ms).
+        frontiers = body.get("frontiers")
+        reads = getattr(self.store, "reads", None)
+        if reads is not None and isinstance(frontiers, dict):
+            for doc_id, frontier in frontiers.items():
+                if frontier:
+                    reads.index.note_advert(doc_id, peer_id, frontier)
+            if frontiers:
+                self.metrics.bump("antientropy", "frontier_adverts",
+                                  len(frontiers))
 
     def handle_join(self, req: dict) -> dict:
         """`POST /replicate/join` — a node announces itself (bootstrap
@@ -509,13 +546,26 @@ class ReplicaNode:
 
     def docs_json(self) -> dict:
         now = time.monotonic()
+        doc_ids = self.store.doc_ids()
+        # follower-read frontier advertisement: our frontier per
+        # IN-MEMORY doc (not-yet-loaded .dt files aren't worth a load
+        # just to advertise). Computed under the store's oplog guard
+        # BEFORE the lease guard below — the two are never nested.
+        frontiers = {}
+        if getattr(self.store, "reads", None) is not None:
+            with self.store.lock:
+                for doc_id, ol in self.store.docs.items():
+                    frontiers[doc_id] = \
+                        ol.cg.local_to_remote_frontier(ol.version)
         docs = {}
         with self.leases.lock:
-            for doc_id in self.store.doc_ids():
+            for doc_id in doc_ids:
                 lease = self.leases.leases.get(doc_id)
                 docs[doc_id] = {
                     "lease": lease.as_json(now) if lease is not None
                     and not lease.expired(now) else None}
+                if doc_id in frontiers:
+                    docs[doc_id]["frontier"] = frontiers[doc_id]
         return {"docs": docs, "self": self.self_id}
 
     # ---- metrics ---------------------------------------------------------

@@ -96,8 +96,11 @@ class ServeMetrics:
     # (`device_calls_per_window` is the N-dispatches-to-1 signal the
     # mesh flush window exists to move) + mesh super-batch occupancy;
     # v7 = the `hydration` block (HYDRATION_KEYS — the cold->warm
-    # residency tier's counters) + `latencies.hydration_cold_start`)
-    SCHEMA_VERSION = 7
+    # residency tier's counters) + `latencies.hydration_cold_start`;
+    # v8 = the `read` block — the follower-read tier's ReadMetrics
+    # snapshot (read/metrics.py READ_KEYS + staleness/read_wait
+    # histograms) when a ReadPath is attached, null otherwise)
+    SCHEMA_VERSION = 8
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -137,6 +140,10 @@ class ServeMetrics:
         # obs.recorder.FlightRecorder, wired by
         # MergeScheduler.attach_obs; only rare events touch it
         self.recorder = None
+        # follower-read tier (read/metrics.py ReadMetrics), wired by
+        # read.attach_follower_reads; the v8 `read` block is its
+        # snapshot, null until a ReadPath is attached
+        self.read = None
 
     # ---- recording -------------------------------------------------------
 
@@ -239,6 +246,7 @@ class ServeMetrics:
         # taking ours (never nest)
         flush_hist = self.flush_latency.snapshot()
         cold_hist = self.cold_start_latency.snapshot()
+        read_snap = self.read.snapshot() if self.read is not None else None
         with self._lock:
             totals = {k: sum(s[k] for s in self.shard)
                       for k in _SHARD_KEYS}
@@ -246,10 +254,10 @@ class ServeMetrics:
             occupancy = (totals["flushed_docs"] / flushes) \
                 / self.flush_docs
             return self._snapshot_locked(totals, occupancy, flush_hist,
-                                         cold_hist)
+                                         cold_hist, read_snap)
 
     def _snapshot_locked(self, totals, occupancy, flush_hist,
-                         cold_hist) -> dict:
+                         cold_hist, read_snap) -> dict:
         return {
             "version": self.SCHEMA_VERSION,
             "uptime_s": round(time.monotonic() - self.started_at, 3),
@@ -291,6 +299,7 @@ class ServeMetrics:
                     sorted(self.window_shards_hist.items())},
             },
             "hydration": dict(self.hydration),
+            "read": read_snap,
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,
             "latencies": {"flush": flush_hist,

@@ -157,6 +157,11 @@ class MergeScheduler:
         # serve.hydrate.Hydrator (attach_hydrator); None = the classic
         # everything-resident scheduler — no prefetch, no flush gate
         self.hydrator = None
+        # read.attach_follower_reads wires this to ReadPath.on_flush:
+        # a completed flush moved the doc's merged tip, so the
+        # follower-read checkout cache drops the doc's entries. Called
+        # OUTSIDE shard/bank locks, right after record_flush.
+        self.read_invalidate: Optional[Callable[[str], None]] = None
         self.lock = make_lock("scheduler.global", "global")
         self._shard_locks = [make_lock(f"shard[{i}]", "shard", rank=i)
                              for i in range(n_shards)]
@@ -481,6 +486,9 @@ class MergeScheduler:
         self.metrics.record_flush(
             shard, len(items), sum(i.n_ops for i in items), reason,
             dur_s=dur)
+        if self.read_invalidate is not None:
+            for it in items:
+                self.read_invalidate(it.doc_id)
 
     # ---- mesh flush window -----------------------------------------------
 
@@ -626,6 +634,9 @@ class MergeScheduler:
                 self.metrics.record_flush(
                     s, len(items), sum(i.n_ops for i in items), reason,
                     dur_s=time.perf_counter() - t0)
+                if self.read_invalidate is not None:
+                    for it in items:
+                        self.read_invalidate(it.doc_id)
         dur = time.perf_counter() - t0
         fspan.end(dur_s=round(dur, 6), dispatches=dispatches)
         self.metrics.record_window(dispatches, n_docs, len(shards),

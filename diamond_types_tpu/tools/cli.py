@@ -371,6 +371,44 @@ def cmd_storage_soak(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_read_bench(args) -> int:
+    """Two-server follower-read A/B bench: Zipf-skewed readers across
+    both nodes, control phase (max_staleness=0: every follower read
+    proxies to the owner) vs follower phase (bounded staleness served
+    locally), with client-side verification of both the staleness
+    bound and the read-your-writes token (see read/bench.py)."""
+    from ..read.bench import run_read_bench
+    report = run_read_bench(
+        docs=args.docs, readers=args.readers,
+        reads_per_reader=args.reads_per_reader, seed=args.seed,
+        zipf_s=args.zipf_s, max_staleness_s=args.max_staleness,
+        min_version_every=args.min_version_every,
+        lease_ttl_s=args.lease_ttl, serve_shards=args.serve_shards,
+        doc_bytes=args.doc_bytes,
+        min_speedup=args.min_speedup, progress=args.progress)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        c, fo = report["control"], report["follower"]
+        print(f"read-bench: {report['config']['docs']} docs / "
+              f"{report['config']['readers']} readers x "
+              f"{report['config']['reads_per_reader']} reads, "
+              f"{report['writes']} writes riding along: "
+              f"control {c['reads_per_s']} reads/s "
+              f"({c['proxied']} proxied), "
+              f"follower {fo['reads_per_s']} reads/s "
+              f"({fo['local']} local, max staleness "
+              f"{fo['max_observed_staleness_s'] * 1e3:.0f}ms), "
+              f"speedup {report['speedup']}x, "
+              f"{report['violations']} contract violations, "
+              f"{report['errors']} errors in {report['wall_s']}s: "
+              + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
+
+
 def cmd_dt_lint(args) -> int:
     """Concurrency invariant lint (analysis/): lock-order violations,
     unsorted multi-lock acquisition, device dispatch under the
@@ -633,6 +671,38 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
     c.set_defaults(fn=cmd_storage_soak)
+
+    c = sub.add_parser(
+        "read-bench",
+        help="two-server follower-read A/B bench: bounded-staleness "
+        "local reads vs owner-only proxying, with client-side "
+        "staleness + read-your-writes verification")
+    c.add_argument("--docs", type=int, default=3)
+    c.add_argument("--readers", type=int, default=6)
+    c.add_argument("--reads-per-reader", type=int, default=120)
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--zipf-s", type=float, default=1.2,
+                   help="Zipf skew of the reader doc distribution")
+    c.add_argument("--max-staleness", type=float, default=2.0,
+                   help="staleness bound (seconds) the follower phase "
+                   "requests on every read")
+    c.add_argument("--min-version-every", type=int, default=4,
+                   help="send the doc's latest write token as "
+                   "X-DT-Min-Version on every Nth read (0 = never)")
+    c.add_argument("--lease-ttl", type=float, default=30.0)
+    c.add_argument("--serve-shards", type=int, default=1,
+                   help="attach the host-engine merge scheduler with "
+                   "N shards on both servers (leases activate through "
+                   "its admit gate, so the bench needs at least 1)")
+    c.add_argument("--doc-bytes", type=int, default=16384,
+                   help="approximate seeded checkout size per doc")
+    c.add_argument("--min-speedup", type=float, default=None,
+                   help="fail unless follower/control aggregate read "
+                   "throughput clears this ratio")
+    c.add_argument("--progress", action="store_true")
+    c.add_argument("--json", action="store_true")
+    c.add_argument("--metrics-out")
+    c.set_defaults(fn=cmd_read_bench)
 
     c = sub.add_parser(
         "dt-lint",

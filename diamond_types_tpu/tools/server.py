@@ -154,6 +154,12 @@ class DocStore:
         # recorder, per-endpoint latency histograms. serve() attaches
         # one; attach_replication forwards it to the ReplicaNode.
         self.obs = None
+        # Optional follower-read tier (read/): staleness-bounded local
+        # GETs on non-owner replicas + the shared checkout cache.
+        # Attached via read.attach_follower_reads (serve
+        # --follower-reads); when absent, GETs keep the classic
+        # always-local behavior.
+        self.reads = None
         from ..analysis.witness import make_lock
         self.lock = make_lock("store.oplog", "oplog")
         # serializes flush passes; deliberately OUTER to the oplog
@@ -565,6 +571,22 @@ def doc_history_strip(ol: OpLog, n: int, tip: Optional[list] = None):
     return out
 
 
+def _parse_frontier_token(tok: str):
+    """Parse an `X-DT-Min-Version` header: a JSON remote frontier
+    ([[agent, seq], ...]). Raises ValueError/TypeError on any shape
+    the read path couldn't evaluate safely."""
+    v = json.loads(tok)
+    if not isinstance(v, list):
+        raise ValueError("token must be a list")
+    out = []
+    for h in v:
+        if not (isinstance(h, (list, tuple)) and len(h) == 2
+                and isinstance(h[0], str)):
+            raise ValueError("bad frontier head")
+        out.append([h[0], int(h[1])])
+    return out
+
+
 class SyncHandler(BaseHTTPRequestHandler):
     store: DocStore = None  # class attr, set by serve()
 
@@ -582,7 +604,9 @@ class SyncHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _route(self):
-        parts = self.path.strip("/").split("/")
+        # query string stripped: GET doc endpoints take contract
+        # params (?max_staleness=) that must not leak into the action
+        parts = self.path.split("?", 1)[0].strip("/").split("/")
         if len(parts) >= 2 and parts[0] == "doc" and _DOC_ID_RE.match(parts[1]):
             return parts[1], (parts[2] if len(parts) > 2 else "")
         return None, None
@@ -649,7 +673,9 @@ class SyncHandler(BaseHTTPRequestHandler):
             node = self.store.replica
             obs = self.store.obs
             doc = {"serve": sched.metrics_json() if sched else None,
-                   "replication": node.metrics_json() if node else None}
+                   "replication": node.metrics_json() if node else None,
+                   "read": self.store.reads.metrics.snapshot()
+                   if self.store.reads is not None else None}
             if obs is not None:
                 doc["obs"] = obs.snapshot()
             qs = urllib.parse.parse_qs(
@@ -694,22 +720,36 @@ class SyncHandler(BaseHTTPRequestHandler):
         doc_id, action = self._route()
         if doc_id is None:
             return self._send(404, b"{}")
+        # every checkout-bearing GET is frontier-dependent state: an
+        # intermediary cache serving it stale would silently violate
+        # the read contract, so all four doc views are no-store
+        no_store = {"Cache-Control": "no-store"}
+        if action in ("", "state") and self.store.reads is not None:
+            return self._read_with_contract(doc_id, action, no_store)
         ol = self.store.get(doc_id)
         if action == "":
             with self.store.lock:
                 text = ol.checkout_tip().snapshot()
+                frontier = ol.cg.local_to_remote_frontier(ol.version)
             return self._send(200, text.encode("utf8"),
-                              "text/plain; charset=utf-8")
+                              "text/plain; charset=utf-8",
+                              extra={**no_store,
+                                     "X-DT-Frontier":
+                                     json.dumps(frontier)})
         if action == "summary":
             with self.store.lock:
                 body = json.dumps(summarize_versions(ol.cg)).encode("utf8")
-            return self._send(200, body)
+            return self._send(200, body, extra=no_store)
         if action == "state":
             with self.store.lock:
+                frontier = ol.cg.local_to_remote_frontier(ol.version)
                 body = json.dumps({
                     "text": ol.checkout_tip().snapshot(),
-                    "version": ol.cg.local_to_remote_frontier(ol.version)})
-            return self._send(200, body.encode("utf8"))
+                    "version": frontier})
+            return self._send(200, body.encode("utf8"),
+                              extra={**no_store,
+                                     "X-DT-Frontier":
+                                     json.dumps(frontier)})
         if action == "graph":
             with self.store.lock:
                 g = ol.cg.graph
@@ -720,8 +760,46 @@ class SyncHandler(BaseHTTPRequestHandler):
                     runs.append({"start": g.starts[i], "end": g.ends[i],
                                  "parents": list(g.parents[i]),
                                  "agent": aa.get_agent_name(agent)})
-            return self._send(200, json.dumps({"runs": runs}).encode("utf8"))
+            return self._send(200, json.dumps({"runs": runs}).encode("utf8"),
+                              extra=no_store)
         return self._send(404, b"{}")
+
+    def _read_with_contract(self, doc_id: str, action: str,
+                            no_store: dict):
+        """Follower-read path for GET /doc/{id} and /doc/{id}/state:
+        parse `?max_staleness=` + `X-DT-Min-Version`, then delegate the
+        local/wait/proxy/refuse decision to the attached ReadPath
+        (read/path.py). `X-DT-Proxied` marks the owner side of a proxy
+        hop — served locally, never re-proxied."""
+        from ..read.path import MIN_VERSION_HEADER
+        qs = urllib.parse.parse_qs(self.path.partition("?")[2],
+                                   keep_blank_values=True)
+        raw = qs.get("max_staleness", [None])[0]
+        max_staleness = None
+        if raw not in (None, ""):
+            try:
+                max_staleness = float(raw)
+            except ValueError:
+                return self._send(400, json.dumps(
+                    {"error": "bad max_staleness"}).encode("utf8"))
+            if max_staleness < 0 or max_staleness != max_staleness:
+                return self._send(400, json.dumps(
+                    {"error": "bad max_staleness"}).encode("utf8"))
+        min_version = None
+        tok = self.headers.get(MIN_VERSION_HEADER)
+        if tok:
+            try:
+                min_version = _parse_frontier_token(tok)
+            except (ValueError, TypeError):
+                return self._send(400, json.dumps(
+                    {"error": "bad min_version token"}).encode("utf8"))
+        res = self.store.reads.read(
+            doc_id, "text" if action == "" else "state",
+            max_staleness=max_staleness, min_version=min_version,
+            forced_local=self.headers.get("X-DT-Proxied") is not None,
+            trace=parse_header(self.headers.get(TRACE_HEADER)))
+        return self._send(res.status, res.body, res.ctype,
+                          extra={**no_store, **res.headers})
 
     def do_POST(self):
         # Malformed JSON bodies / missing keys / non-numeric values on any
@@ -855,6 +933,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     collisions = None
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
+            if self.store.reads is not None:
+                self.store.reads.on_local_mutation(doc_id)
             if n_new:
                 self.store.submit_merge(doc_id, n_new,
                                         trace=self._trace_ctx())
@@ -909,6 +989,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                 out = ol.cg.local_to_remote_frontier(frontier)
             self.store.mark_dirty(doc_id)
             self.store.notify(doc_id)
+            if self.store.reads is not None:
+                self.store.reads.on_local_mutation(doc_id)
             self.store.submit_merge(doc_id, len(ops),
                                     trace=self._trace_ctx())
             return self._send(200, json.dumps({"version": out})
@@ -977,6 +1059,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     # (both helpers take store.lock themselves)
                     self.store.mark_dirty(doc_id)
                     self.store.notify(doc_id)
+                    if self.store.reads is not None:
+                        self.store.reads.on_local_mutation(doc_id)
                     self.store.submit_merge(doc_id, applied,
                                             trace=self._trace_ctx())
             return self._send(200, json.dumps(
@@ -1035,7 +1119,9 @@ class _Server(ThreadingHTTPServer):
 def serve(port: int = 8008, data_dir: Optional[str] = None,
           serve_shards: int = 0, peers: Optional[list] = None,
           replicate_opts: Optional[dict] = None,
-          obs_opts: Optional[dict] = None) -> ThreadingHTTPServer:
+          obs_opts: Optional[dict] = None,
+          follower_reads: bool = False,
+          read_opts: Optional[dict] = None) -> ThreadingHTTPServer:
     """`peers` is the static mesh (["host:port", ...], may include
     this server's own address — it is dropped from the table). With
     peers set, a replicate.ReplicaNode is attached and started: health
@@ -1062,6 +1148,12 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
         store.attach_scheduler(sched)
         sched.attach_obs(store.obs)
         sched.start_pump()
+    if follower_reads:
+        # staleness-bounded local GETs on non-owner replicas + the
+        # shared checkout cache; harmless (always-owner) on a
+        # single-node server
+        from ..read import attach_follower_reads
+        attach_follower_reads(store, **(read_opts or {}))
     handler = type("Handler", (SyncHandler,), {"store": store})
     httpd = _Server(("127.0.0.1", port), handler)
     httpd.store = store
@@ -1167,6 +1259,11 @@ def main() -> None:
     p.add_argument("--obs-sample-rate", type=float, default=0.01,
                    help="trace head-sampling rate (0 disables tracing; "
                    "histograms and the flight recorder are always on)")
+    p.add_argument("--follower-reads", action="store_true",
+                   help="serve GET /doc/{id}[/state] from this replica "
+                   "under the staleness contract (?max_staleness= + "
+                   "X-DT-Min-Version) instead of always locally; "
+                   "contract misses proxy to the doc's owner")
     args = p.parse_args()
     peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
         if args.peers else ([] if args.join else None)
@@ -1174,7 +1271,8 @@ def main() -> None:
                   serve_shards=args.serve_shards, peers=peers,
                   replicate_opts={"lease_ttl_s": args.lease_ttl,
                                   "join": args.join},
-                  obs_opts={"sample_rate": args.obs_sample_rate})
+                  obs_opts={"sample_rate": args.obs_sample_rate},
+                  follower_reads=args.follower_reads)
     print(f"serving on http://127.0.0.1:{args.port}"
           + (f" (mesh: {','.join(peers)})" if peers else ""))
     httpd.serve_forever()

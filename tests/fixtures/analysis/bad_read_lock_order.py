@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: follower-read cache lock-order violation.
+
+Acquires the checkout cache's guard (io, 25) while already holding the
+oplog guard (30) — backwards against the canonical order: the cache
+guard is deliberately OUTER to oplog (the single-flight leader
+materializes checkouts under the oplog guard OUTSIDE the cache guard,
+never the reverse).
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureReadPath:
+    def backwards(self, doc_id, fkey):
+        with self.store.lock:
+            with self._cache_lock:
+                return self._entries.get((doc_id, fkey))
