@@ -774,7 +774,8 @@ def bench_codec(name: str):
 def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
                       engine: str = "device", timeout: int = 300,
                       fused: bool = True, steady_rounds: int = 8,
-                      mesh_window: bool = False):
+                      mesh_window: bool = False,
+                      telemetry: bool = True):
     """Sharded multi-document merge scheduler (serve/): replays the
     synthetic trace across `docs` docs on `shards` CPU-simulated shards
     through the router + shape-bucketed admission queue + per-shard
@@ -800,6 +801,8 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
         cmd.append("--mesh-window")
     if fused:
         cmd.append("--warmup")
+    if not telemetry:
+        cmd.append("--no-telemetry")
     p = subprocess.run(cmd, capture_output=True, text=True,
                        timeout=timeout,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -1433,6 +1436,23 @@ def _main() -> None:
                     3)
         except Exception as e:  # pragma: no cover
             extra["serve_sched"]["mesh_error"] = str(e)[:120]
+        # live-telemetry overhead A/B on the same trace: windowed
+        # TimeSeries + SLO engine + exemplars + hot-doc attribution
+        # disabled. The live tier's contract is <=3% of serve-bench
+        # throughput — `telemetry_overhead_ok` is the guard
+        try:
+            svt = bench_serve_sched(telemetry=False)
+            full["serve_sched_no_telemetry"] = svt
+            base = svt["ops_per_sec"]
+            overhead = round(1.0 - sv["ops_per_sec"] / max(base, 1),
+                             4)
+            extra["serve_sched"]["no_telemetry_ops_per_sec"] = base
+            extra["serve_sched"]["telemetry_overhead"] = overhead
+            extra["serve_sched"]["telemetry_overhead_ok"] = \
+                overhead <= 0.03
+            extra["serve_sched"]["slo_ok"] = sv.get("slo_ok")
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched"]["telemetry_error"] = str(e)[:120]
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 

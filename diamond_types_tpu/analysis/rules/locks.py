@@ -103,6 +103,13 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
         return "io"
     if "_first_touch_lock" in src or "_jit_lock" in src:
         return "leaf"
+    # live-telemetry tier: the TimeSeries ring guard (`_ts_lock`, also
+    # the exemplar store) and the top-K sketch guard (`_sketch_lock`)
+    # are leaf rungs — record_*/note() double-writes happen while the
+    # caller already holds serve/read/replicate locks, and the obs
+    # structures never call back out while held
+    if "_ts_lock" in src or "_sketch_lock" in src:
+        return "leaf"
     if src in ("self.lock", "self._lock", "lock"):
         if "Scheduler" in class_name:
             return "global"

@@ -2,11 +2,15 @@
 
 One `Observability` bundle per server process ties together:
 
-  trace.py     sampled spans with X-DT-Trace cross-host propagation
-  hist.py      log-bucketed latency histograms (p50/p90/p99)
-  recorder.py  flight recorder — bounded ring of structured events
-  prom.py      Prometheus text exposition of the /metrics JSON
-  devprof.py   wall-vs-device flush timing, jit-cache hits, transfers
+  trace.py      sampled spans with X-DT-Trace cross-host propagation
+  hist.py       log-bucketed latency histograms (p50/p90/p99)
+  recorder.py   flight recorder — bounded ring of structured events
+  prom.py       Prometheus/OpenMetrics exposition of the /metrics JSON
+  devprof.py    wall-vs-device flush timing, jit-cache hits, transfers
+  timeseries.py windowed ring: live rate()/quantile() per family
+  slo.py        multi-window burn-rate SLO engine (/debug/slo)
+  exemplars.py  last sampled trace id per histogram bucket
+  attrib.py     top-K hot-doc/agent sketch (/debug/hot)
 
 The bundle is attached as `DocStore.obs` by tools/server.serve() and
 propagated from there: MergeScheduler.attach_obs() wires the tracer
@@ -18,10 +22,14 @@ hot paths pay one branch, zero allocations.
 
 from __future__ import annotations
 
+from .attrib import HotAttribution, SpaceSaving
 from .devprof import PROFILER, DeviceProfiler, note_jit_lookup, note_transfer
+from .exemplars import ExemplarStore
 from .hist import BOUNDS, Histogram, HistogramSet
-from .prom import CONTENT_TYPE, render_metrics
+from .prom import CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, render_metrics
 from .recorder import FlightRecorder
+from .slo import Objective, SloEngine, default_objectives
+from .timeseries import TimeSeries
 from .trace import (NOOP_SPAN, TRACE_HEADER, Span, SpanContext, Tracer,
                     format_context, parse_header)
 
@@ -30,8 +38,10 @@ __all__ = [
     "TRACE_HEADER", "format_context", "parse_header",
     "Histogram", "HistogramSet", "BOUNDS",
     "FlightRecorder",
-    "CONTENT_TYPE", "render_metrics",
+    "CONTENT_TYPE", "OPENMETRICS_CONTENT_TYPE", "render_metrics",
     "PROFILER", "DeviceProfiler", "note_jit_lookup", "note_transfer",
+    "TimeSeries", "SloEngine", "Objective", "default_objectives",
+    "ExemplarStore", "HotAttribution", "SpaceSaving",
 ]
 
 
@@ -47,19 +57,37 @@ class Observability:
     def __init__(self, sample_rate: float = 0.01,
                  trace_capacity: int = 2048,
                  recorder_capacity: int = 512,
-                 seed: int = 0, enabled: bool = True) -> None:
+                 seed: int = 0, enabled: bool = True,
+                 telemetry: bool = True,
+                 ts_window_s: float = 10.0, ts_windows: int = 360,
+                 objectives=None, attrib_k: int = 64) -> None:
         self.tracer = Tracer(sample_rate=sample_rate,
                              capacity=trace_capacity,
                              seed=seed, enabled=enabled)
         self.recorder = FlightRecorder(capacity=recorder_capacity,
                                        enabled=enabled)
         self.hist = HistogramSet()
+        # live telemetry tier: windowed time-series + SLO burn rates +
+        # exemplars + hot-key attribution. `telemetry=False` keeps the
+        # cumulative tier while turning every live-tier write into a
+        # single-branch no-op (the bench A/B toggle).
+        live = enabled and telemetry
+        self.ts = TimeSeries(window_s=ts_window_s, n_windows=ts_windows,
+                             enabled=live)
+        self.slo = SloEngine(self.ts, objectives=objectives,
+                             recorder=self.recorder)
+        self.exemplars = ExemplarStore(enabled=live)
+        self.attrib = HotAttribution(k=attrib_k, enabled=live)
 
     def snapshot(self) -> dict:
         out = {"trace": self.tracer.stats(),
                "recorder": self.recorder.stats(),
                "http": self.hist.snapshot(),
-               "devprof": PROFILER.snapshot()}
+               "devprof": PROFILER.snapshot(),
+               "timeseries": self.ts.snapshot(),
+               "slo": self.slo.snapshot(),
+               "exemplars": self.exemplars.snapshot(),
+               "hot": self.attrib.snapshot()}
         # concurrency-invariant tier (analysis/): the runtime lock
         # witness is always reported (enabled=False when off); the
         # lint block appears once a dt-lint run published a report in

@@ -19,10 +19,26 @@ Naming scheme:
                                       antientropy_round)
   dt_http_request_seconds{endpoint,method}
   dt_trace_* / dt_recorder_* / dt_devprof_*
+  dt_slo_*{objective}                 burn-rate gauges + alert state
+  dt_hot_*{dim,kind[,key]}            top-K attribution (bounded: the
+                                      sketch caps key cardinality)
+  dt_ts_*{series}                     live windowed rates / p99
 
 Each metric name is declared exactly once (# TYPE line) no matter how
 many labeled samples it carries; label values are escaped per the
 exposition spec (backslash, double-quote, newline).
+
+Known-at-registration families (`dt_read_*`, `dt_serve_hydration_*`)
+are zero-filled whenever a serve block is present, so a scraper never
+sees a series flicker into existence on first use.
+
+`render_metrics(doc, openmetrics=True)` emits OpenMetrics 1.0 instead:
+counter TYPE lines drop the `_total` suffix (samples keep it), the
+output is terminated by `# EOF`, and histogram `_bucket` lines carry
+trace exemplars (`# {trace_id="..."} value ts`) wherever the exemplar
+store saw a sampled trace land in that bucket — the p99-outlier-to-
+flight-recorder hop. tools/server.py negotiates the format from the
+Accept header.
 """
 
 from __future__ import annotations
@@ -30,6 +46,24 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 CONTENT_TYPE = "text/plain; version=0.0.4"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+# prom histogram family -> obs.timeseries family, for exemplar lookup
+_EXEMPLAR_FAMILIES = {
+    "dt_flush_latency_seconds": "serve.flush",
+    "dt_queue_wait_latency_seconds": "serve.queue_wait",
+    "dt_hydration_cold_start_latency_seconds":
+        "serve.hydration_cold_start",
+    "dt_quorum_round_latency_seconds": "repl.quorum_round",
+    "dt_handoff_latency_seconds": "repl.handoff",
+    "dt_read_staleness_seconds": "read.staleness",
+    "dt_read_wait_latency_seconds": "read.read_wait",
+}
+
+_SLO_STATE_CODE = {"ok": 0, "warning": 1, "burning": 2}
+
+_EMPTY_HIST = {"count": 0, "sum": 0.0, "buckets": [["+Inf", 0]]}
 
 
 def escape_label_value(v) -> str:
@@ -55,31 +89,49 @@ def _fmt_labels(labels: Optional[dict]) -> str:
 
 class _Builder:
     """Accumulates samples grouped by metric family so every name gets
-    exactly one # TYPE declaration."""
+    exactly one # TYPE declaration. In OpenMetrics mode counter TYPE
+    lines drop the `_total` suffix, `_bucket` samples may carry
+    exemplars, and the output ends with `# EOF`."""
 
-    def __init__(self) -> None:
+    def __init__(self, openmetrics: bool = False,
+                 exemplars: Optional[dict] = None) -> None:
+        self.openmetrics = openmetrics
+        # metric family name -> {le_string -> {trace, value, ts}}
+        self._exemplars = exemplars or {}
         self._order: List[str] = []
         self._fams: Dict[str, dict] = {}
 
     def add(self, name: str, mtype: str, value,
             labels: Optional[dict] = None,
-            suffix: str = "") -> None:
+            suffix: str = "", exemplar: str = "") -> None:
         fam = self._fams.get(name)
         if fam is None:
             fam = {"type": mtype, "lines": []}
             self._fams[name] = fam
             self._order.append(name)
         fam["lines"].append(
-            f"{name}{suffix}{_fmt_labels(labels)} {_fmt_value(value)}")
+            f"{name}{suffix}{_fmt_labels(labels)} "
+            f"{_fmt_value(value)}{exemplar}")
 
     def histogram(self, name: str, snap: dict,
                   labels: Optional[dict] = None) -> None:
         """Render one obs.hist.Histogram.snapshot() (with `buckets`)
         as a Prometheus histogram family."""
+        fam_ex = self._exemplars.get(name) if self.openmetrics else None
         for le, cum in snap.get("buckets", []):
             bl = dict(labels or {})
-            bl["le"] = le if isinstance(le, str) else repr(float(le))
-            self.add(name, "histogram", cum, labels=bl, suffix="_bucket")
+            le_s = le if isinstance(le, str) else repr(float(le))
+            bl["le"] = le_s
+            ex = ""
+            if fam_ex:
+                row = fam_ex.get(le_s)
+                if row:
+                    ex = (f' # {{trace_id="'
+                          f'{escape_label_value(row["trace"])}"}} '
+                          f'{_fmt_value(row["value"])} '
+                          f'{_fmt_value(row["ts"])}')
+            self.add(name, "histogram", cum, labels=bl,
+                     suffix="_bucket", exemplar=ex)
         self.add(name, "histogram", snap.get("sum", 0.0),
                  labels=labels, suffix="_sum")
         self.add(name, "histogram", snap.get("count", 0),
@@ -89,9 +141,16 @@ class _Builder:
         out: List[str] = []
         for name in self._order:
             fam = self._fams[name]
-            out.append(f"# TYPE {name} {fam['type']}")
+            tname = name
+            if (self.openmetrics and fam["type"] == "counter"
+                    and tname.endswith("_total")):
+                tname = tname[:-len("_total")]
+            out.append(f"# TYPE {tname} {fam['type']}")
             out.extend(fam["lines"])
-        return "\n".join(out) + "\n"
+        text = "\n".join(out) + "\n"
+        if self.openmetrics:
+            text += "# EOF\n"
+        return text
 
 
 def _render_serve(b: _Builder, serve: dict) -> None:
@@ -108,8 +167,13 @@ def _render_serve(b: _Builder, serve: dict) -> None:
         b.add(f"dt_serve_{k}_total", "counter", v)
     # residency tier (metrics v7): cold->warm hydration + snapshot
     # eviction counters; the cold-start histogram rides the shared
-    # latencies loop below as dt_hydration_cold_start_latency_seconds
-    for k, v in sorted((serve.get("hydration") or {}).items()):
+    # latencies loop below as dt_hydration_cold_start_latency_seconds.
+    # Zero-filled over HYDRATION_KEYS so the family exists from the
+    # first scrape, not from the first hydration.
+    from ..serve.metrics import HYDRATION_KEYS
+    hyd = {k: 0 for k in HYDRATION_KEYS}
+    hyd.update(serve.get("hydration") or {})
+    for k, v in sorted(hyd.items()):
         b.add(f"dt_serve_hydration_{k}_total", "counter", v)
     for reason, n in sorted((serve.get("flush_reasons") or {}).items()):
         b.add("dt_serve_flush_reason_total", "counter", n,
@@ -165,15 +229,19 @@ def _render_read(b: _Builder, read: dict) -> None:
     top-level `read` key): READ_KEYS counters as dt_read_*_total, the
     local-serve ratio gauge, the staleness histogram, and the catch-up
     wait histogram (via the shared latency naming)."""
-    for k, v in sorted((read.get("counters") or {}).items()):
+    from ..read.metrics import READ_KEYS
+    counters = {k: 0 for k in READ_KEYS}
+    counters.update(read.get("counters") or {})
+    for k, v in sorted(counters.items()):
         b.add(f"dt_read_{k}_total", "counter", v)
-    lr = read.get("local_ratio")
-    if lr is not None:
-        b.add("dt_read_local_ratio", "gauge", lr)
+    b.add("dt_read_local_ratio", "gauge",
+          read.get("local_ratio") or 0.0)
     st = read.get("staleness")
-    if isinstance(st, dict) and st:
-        b.histogram("dt_read_staleness_seconds", st)
-    for name, snap in sorted((read.get("latencies") or {}).items()):
+    b.histogram("dt_read_staleness_seconds",
+                st if isinstance(st, dict) and st else _EMPTY_HIST)
+    lat = dict(read.get("latencies") or {})
+    lat.setdefault("read_wait", _EMPTY_HIST)
+    for name, snap in sorted(lat.items()):
         b.histogram(f"dt_{name}_latency_seconds", snap)
 
 
@@ -245,22 +313,85 @@ def _render_obs(b: _Builder, obs: dict) -> None:
                   labels={"rule": rule})
         b.add("dt_lint_files", "gauge", lint.get("files", 0))
         b.add("dt_lint_ok", "gauge", 1 if lint.get("ok") else 0)
+    # live telemetry tier: SLO burn-rate gauges, windowed rates, and
+    # the top-K hot-doc/agent attribution (all bounded cardinality)
+    slo = obs.get("slo") or {}
+    for row in slo.get("objectives") or []:
+        lb = {"objective": row["name"]}
+        b.add("dt_slo_state", "gauge",
+              _SLO_STATE_CODE.get(row["state"], 0), labels=lb)
+        b.add("dt_slo_burn_rate", "gauge", row["fast"]["burn"],
+              labels=dict(lb, window="fast"))
+        b.add("dt_slo_burn_rate", "gauge", row["slow"]["burn"],
+              labels=dict(lb, window="slow"))
+        b.add("dt_slo_transitions_total", "counter",
+              row["transitions"], labels=lb)
+    if slo:
+        b.add("dt_slo_ok", "gauge", 1 if slo.get("ok", True) else 0)
+    ts = obs.get("timeseries") or {}
+    if ts:
+        b.add("dt_ts_enabled", "gauge", 1 if ts.get("enabled") else 0)
+        b.add("dt_ts_recorded_total", "counter", ts.get("recorded", 0))
+        for series, row in sorted((ts.get("series") or {}).items()):
+            lb = {"series": series}
+            if "rate_60s" in row:
+                b.add("dt_ts_rate", "gauge", row["rate_60s"],
+                      labels=dict(lb, window="60s"))
+            if "p99_300s" in row:
+                b.add("dt_ts_p99_seconds", "gauge", row["p99_300s"],
+                      labels=lb)
+    hot = obs.get("hot") or {}
+    for dim in ("doc", "agent"):
+        for kind, block in sorted((hot.get(dim) or {}).items()):
+            lb = {"dim": dim, "kind": kind}
+            b.add("dt_hot_attributed_total", "counter",
+                  block.get("total", 0.0), labels=lb)
+            for key, count, _err in block.get("top") or []:
+                b.add("dt_hot_top", "gauge", count,
+                      labels=dict(lb, key=key))
+    ex = obs.get("exemplars") or {}
+    if ex:
+        b.add("dt_exemplars_noted_total", "counter",
+              ex.get("noted", 0))
 
 
-def render_metrics(doc: dict) -> str:
-    """Flatten the /metrics JSON document to Prometheus text format."""
-    b = _Builder()
+def _exemplar_index(obs: dict) -> dict:
+    """{prom family -> {le_string -> exemplar row}} from the exemplar
+    store's snapshot (family names are TimeSeries series names)."""
+    fams = (obs.get("exemplars") or {}).get("families") or {}
+    out: Dict[str, dict] = {}
+    for metric, series in _EXEMPLAR_FAMILIES.items():
+        rows = fams.get(series)
+        if not rows:
+            continue
+        out[metric] = {
+            (r["le"] if isinstance(r["le"], str)
+             else repr(float(r["le"]))): r
+            for r in rows}
+    return out
+
+
+def render_metrics(doc: dict, openmetrics: bool = False) -> str:
+    """Flatten the /metrics JSON document to Prometheus text format
+    (or OpenMetrics 1.0 with exemplars when `openmetrics=True`)."""
+    obs_doc = doc.get("obs")
+    b = _Builder(openmetrics=openmetrics,
+                 exemplars=_exemplar_index(obs_doc)
+                 if openmetrics and isinstance(obs_doc, dict) else None)
     serve = doc.get("serve")
     if isinstance(serve, dict):
         _render_serve(b, serve)
     # the read block rides either at top level (scheduler-less
     # servers) or inside the serve snapshot (ServeMetrics v8); render
-    # whichever is present, once
+    # whichever is present, once. A serving process with no read tier
+    # yet still zero-fills the dt_read_* families (no series flicker).
     read = doc.get("read")
     if not isinstance(read, dict) and isinstance(serve, dict):
         read = serve.get("read")
     if isinstance(read, dict):
         _render_read(b, read)
+    elif isinstance(serve, dict):
+        _render_read(b, {})
     repl = doc.get("replication")
     if isinstance(repl, dict):
         _render_replication(b, repl)

@@ -47,6 +47,15 @@ class FlightRecorder:
             evs = list(self._buf)
         return evs[-n:] if n else evs
 
+    def dump_since(self, since: int) -> list:
+        """Events with seq > since — incremental tailing for
+        `GET /debug/events?since=` / `obs-watch` polling. The ring may
+        have dropped events between `since` and the oldest buffered
+        one; callers detect the gap when the first returned seq is not
+        since + 1."""
+        with self._lock:
+            return [ev for ev in self._buf if ev["seq"] > since]
+
     def tail(self, n: int = 50) -> list:
         return self.dump(n)
 

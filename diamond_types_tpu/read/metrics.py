@@ -69,16 +69,27 @@ class ReadMetrics:
         self.staleness = Histogram()
         # Wall time spent in the bounded catch-up wait, satisfied or not.
         self.wait = Histogram()
+        # live-telemetry double-write target (obs TimeSeries), wired by
+        # read.attach_follower_reads when the store has an obs bundle
+        self.ts = None
 
     def bump(self, key: str, n: int = 1) -> None:
         with self._lock:
             self._c[key] += n
+        if self.ts is not None:
+            self.ts.inc(f"read.{key}", n)
 
     def observe_staleness(self, seconds: float) -> None:
-        self.staleness.record(max(0.0, seconds))
+        s = max(0.0, seconds)
+        self.staleness.record(s)
+        if self.ts is not None:
+            self.ts.observe("read.staleness", s)
 
     def observe_wait(self, seconds: float) -> None:
-        self.wait.record(max(0.0, seconds))
+        s = max(0.0, seconds)
+        self.wait.record(s)
+        if self.ts is not None:
+            self.ts.observe("read.read_wait", s)
 
     def snapshot(self) -> dict:
         with self._lock:

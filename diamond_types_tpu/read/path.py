@@ -225,6 +225,11 @@ class ReadPath:
         ``forced_local`` marks the owner side of a proxy hop: never
         proxy again (loop guard), but still honor the token."""
         self.metrics.bump("reads")
+        obs = self.obs
+        if obs is not None and getattr(obs, "attrib", None) is not None:
+            # per-doc read attribution: "which doc is hot" is exactly
+            # what follower-read placement wants out of /debug/hot
+            obs.attrib.note("ops", doc=doc_id)
         ol = self.store.get(doc_id)
         node = self.node
 
@@ -291,4 +296,9 @@ def attach_follower_reads(store, **opts) -> ReadPath:
         sched.read_invalidate = rp.on_flush
         if getattr(sched, "metrics", None) is not None:
             sched.metrics.read = rp.metrics
+    # live-telemetry double-write: read counters/staleness/waits land
+    # in the windowed TimeSeries for the read-staleness SLO
+    obs = getattr(store, "obs", None)
+    if obs is not None and getattr(obs, "ts", None) is not None:
+        rp.metrics.ts = obs.ts
     return rp

@@ -93,10 +93,15 @@ class ReplicationMetrics:
             g: {k: 0 for k in keys} for g, keys in _GROUPS.items()}
         self.hist: Dict[str, Histogram] = {
             n: Histogram() for n in _LATENCY_NAMES}
+        # live-telemetry double-write target (obs TimeSeries), wired by
+        # attach_replication when the server carries an obs bundle
+        self.ts = None
 
     def bump(self, group: str, key: str, n: int = 1) -> None:
         with self._lock:
             self._c[group][key] += n
+        if self.ts is not None:
+            self.ts.inc(f"repl.{group}.{key}", n)
 
     def get(self, group: str, key: str) -> int:
         with self._lock:
@@ -108,6 +113,8 @@ class ReplicationMetrics:
             with self._lock:
                 h = self.hist.setdefault(name, Histogram())
         h.record(seconds)
+        if self.ts is not None:
+            self.ts.observe(f"repl.{name}", seconds)
 
     def observe_handoff_latency(self, seconds: float) -> None:
         self.observe_latency("handoff", seconds)

@@ -108,6 +108,9 @@ class ReplicaNode:
         if obs is not None:
             self.table.recorder = obs.recorder
             self.leases.recorder = obs.recorder
+            # live telemetry: replication counters + quorum/handoff
+            # latencies double-write into the windowed TimeSeries
+            self.metrics.ts = getattr(obs, "ts", None)
         # ---- crash-restart restore ----
         self.journal: Optional[ReplicaJournal] = None
         self.rejoining = False

@@ -126,7 +126,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     fused: bool = True, flush_workers: bool = True,
                     warmup: bool = False,
                     steady_rounds: int = 0,
-                    mesh_window: bool = False) -> dict:
+                    mesh_window: bool = False,
+                    telemetry: bool = True) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
     report with throughput, the metrics snapshot, the parity gate, and
     the device-profiler snapshot (wall vs. device time per flush, jit
@@ -179,7 +180,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         sync_lock=oplog_lock, fused=fused,
         flush_workers=flush_workers, warmup=warmup,
         mesh_window=mesh_window)
-    obs = Observability(sample_rate=obs_sample_rate, seed=seed)
+    obs = Observability(sample_rate=obs_sample_rate, seed=seed,
+                        telemetry=telemetry)
     sched.attach_obs(obs)
     if warmup:
         # the bench should measure warm-cache flushes, not count the
@@ -258,6 +260,11 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     wall = time.perf_counter() - t0
 
     m = sched.metrics_json()
+    # evaluate SLO burn rates over the run's telemetry before building
+    # the verdict: a bench that passes parity but burned its latency
+    # budget should fail loudly, not average the burn away
+    obs.slo.evaluate()
+    slo_verdict = obs.slo.verdict()
     report = {
         "config": {"shards": shards, "docs": docs, "engine": engine,
                    "mode": mode, "corpus": corpus,
@@ -268,7 +275,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                    "fused": sched.fused,
                    "flush_workers": flush_workers, "warmup": warmup,
                    "steady_rounds": steady_rounds,
-                   "mesh_window": sched.mesh_window},
+                   "mesh_window": sched.mesh_window,
+                   "telemetry": telemetry},
         "total_ops": total_ops,
         "submit_retries": retries,
         "feed_wall_s": round(feed_wall, 3),
@@ -276,6 +284,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         "ops_per_sec": round(total_ops / max(feed_wall, 1e-9)),
         "parity_ok": not mismatches,
         "parity_mismatches": mismatches,
+        "slo": slo_verdict,
+        "slo_ok": slo_verdict["slo_ok"],
         "fused_device_calls": m["fused"]["device_calls"],
         "fused_occupancy": m["fused"]["occupancy"],
         # the N-dispatches-to-1 signal: device programs per flush
@@ -285,7 +295,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
             m["window"]["device_calls_per_window"],
         "metrics": m,
         "devprof": PROFILER.snapshot(),
-        "obs": {"trace": obs.tracer.stats()},
+        "obs": {"trace": obs.tracer.stats(),
+                "ts_recorded": obs.ts.recorded},
     }
     PROFILER.enabled = False
     if mismatches:

@@ -84,6 +84,8 @@ class Hydrator:
         self.oplog_lock = oplog_lock
         self.metrics = metrics      # ServeMetrics (attach_hydrator)
         self.recorder = recorder    # obs FlightRecorder, may be None
+        self.attrib = None          # obs HotAttribution (attach_obs):
+                                    # per-doc cache-miss attribution
         self.backoff = backoff if backoff is not None else Backoff(
             base_s=0.002, cap_s=0.05, seed=seed, key="hydrate")
         self._hydrate_lock = make_lock("hydrate.warm", "io")
@@ -299,6 +301,11 @@ class Hydrator:
             self._bump("warm_hits")
             return ol
         self._bump("sync_hydrations")
+        # a sync hydration is the residency tier's cache miss — the
+        # per-doc hot sketch is how "one doc thrashes the warm set"
+        # shows up at /debug/hot
+        if self.attrib is not None:
+            self.attrib.note("cache_misses", doc=doc_id)
         t0 = time.monotonic()
         try:
             ol = self._load_with_retries(doc_id, t0 + self.sync_wait_s)

@@ -33,7 +33,8 @@ Schema (snapshot()):
    "max_depth_seen": d,
    "queue_bound_violations": 0,     # depth observed above max_pending
    "latencies": {"flush": hist,     # obs.hist snapshot w/ p50/p90/p99
-                 "hydration_cold_start": hist},  # prefetch/miss -> warm
+                 "hydration_cold_start": hist,   # prefetch/miss -> warm
+                 "queue_wait": hist},            # admit -> flush start
    "per_shard": [{"shard", "queue_depth", "submits", "rejects",
                   "flushes", "flushed_docs", "builds", "evictions",
                   "resyncs", "host_fallbacks", "footprint_slots",
@@ -99,8 +100,12 @@ class ServeMetrics:
     # residency tier's counters) + `latencies.hydration_cold_start`;
     # v8 = the `read` block — the follower-read tier's ReadMetrics
     # snapshot (read/metrics.py READ_KEYS + staleness/read_wait
-    # histograms) when a ReadPath is attached, null otherwise)
-    SCHEMA_VERSION = 8
+    # histograms) when a ReadPath is attached, null otherwise;
+    # v9 = `latencies.queue_wait` (admit -> flush-start wait per merged
+    # item, the admission-SLO signal) + the live-telemetry double-write
+    # (`ts` TimeSeries, wired by attach_obs: every counter/latency also
+    # lands in the windowed ring so rate()/quantile() answer "now")
+    SCHEMA_VERSION = 9
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -130,6 +135,7 @@ class ServeMetrics:
         self.queue_depth: List[int] = [0] * n_shards
         self.footprint_slots: List[int] = [0] * n_shards
         self.flush_latency = Histogram()
+        self.queue_wait_latency = Histogram()
         # residency-tier counters: all zero until a Hydrator is
         # attached (the block is always exported so dashboards don't
         # need schema forks)
@@ -144,12 +150,18 @@ class ServeMetrics:
         # read.attach_follower_reads; the v8 `read` block is its
         # snapshot, null until a ReadPath is attached
         self.read = None
+        # live-telemetry tier (obs/timeseries.py TimeSeries), wired by
+        # MergeScheduler.attach_obs; None (or disabled) => every
+        # double-write below is a single branch, no allocation
+        self.ts = None
 
     # ---- recording -------------------------------------------------------
 
     def bump(self, shard: int, key: str, n: int = 1) -> None:
         with self._lock:
             self.shard[shard][key] += n
+        if self.ts is not None:
+            self.ts.inc(f"serve.{key}", n)
 
     def record_flush(self, shard: int, n_docs: int, n_ops: int,
                      reason: str, dur_s: float = 0.0) -> None:
@@ -164,6 +176,9 @@ class ServeMetrics:
                 self.flush_size_hist.get(n_docs, 0) + 1
         # histogram carries its own lock; record outside ours
         self.flush_latency.record(dur_s)
+        if self.ts is not None:
+            self.ts.observe("serve.flush", dur_s)
+            self.ts.inc("serve.flushed_ops", n_ops)
 
     def record_fused(self, shard: int, n_docs: int) -> None:
         """One fused bucket replay: `n_docs` documents folded into a
@@ -233,11 +248,22 @@ class ServeMetrics:
         ones."""
         with self._lock:
             self.hydration[event] = self.hydration.get(event, 0) + n
+        if self.ts is not None:
+            self.ts.inc(f"serve.hydration.{event}", n)
 
     def observe_cold_start(self, dur_s: float) -> None:
         """Cold-start latency: prefetch enqueue (or resolve miss) to
         warm install. The histogram has its own lock."""
         self.cold_start_latency.record(dur_s)
+        if self.ts is not None:
+            self.ts.observe("serve.hydration_cold_start", dur_s)
+
+    def observe_queue_wait(self, dur_s: float) -> None:
+        """Admit (or coalesce origin) -> flush-start wait for one
+        queued merge — the admission-deadline SLO signal."""
+        self.queue_wait_latency.record(dur_s)
+        if self.ts is not None:
+            self.ts.observe("serve.queue_wait", dur_s)
 
     # ---- export ----------------------------------------------------------
 
@@ -246,6 +272,7 @@ class ServeMetrics:
         # taking ours (never nest)
         flush_hist = self.flush_latency.snapshot()
         cold_hist = self.cold_start_latency.snapshot()
+        queue_wait_hist = self.queue_wait_latency.snapshot()
         read_snap = self.read.snapshot() if self.read is not None else None
         with self._lock:
             totals = {k: sum(s[k] for s in self.shard)
@@ -254,10 +281,11 @@ class ServeMetrics:
             occupancy = (totals["flushed_docs"] / flushes) \
                 / self.flush_docs
             return self._snapshot_locked(totals, occupancy, flush_hist,
-                                         cold_hist, read_snap)
+                                         cold_hist, queue_wait_hist,
+                                         read_snap)
 
     def _snapshot_locked(self, totals, occupancy, flush_hist,
-                         cold_hist, read_snap) -> dict:
+                         cold_hist, queue_wait_hist, read_snap) -> dict:
         return {
             "version": self.SCHEMA_VERSION,
             "uptime_s": round(time.monotonic() - self.started_at, 3),
@@ -303,7 +331,8 @@ class ServeMetrics:
             "max_depth_seen": self.max_depth_seen,
             "queue_bound_violations": self.queue_bound_violations,
             "latencies": {"flush": flush_hist,
-                          "hydration_cold_start": cold_hist},
+                          "hydration_cold_start": cold_hist,
+                          "queue_wait": queue_wait_hist},
             "per_shard": [
                 {"shard": i, "queue_depth": self.queue_depth[i],
                  "footprint_slots": self.footprint_slots[i],

@@ -186,10 +186,15 @@ class MergeScheduler:
         violations, fenced flushes) into the flight recorder."""
         self.obs = obs
         self.metrics.recorder = obs.recorder
+        # live-telemetry tier: counters/latencies double-write into the
+        # windowed TimeSeries (rate()/quantile() "now" queries + SLO
+        # burn rates); per-doc/agent usage feeds the top-K sketch
+        self.metrics.ts = getattr(obs, "ts", None)
         for bank in self.banks:
             bank.recorder = obs.recorder
         if self.hydrator is not None:
             self.hydrator.recorder = obs.recorder
+            self.hydrator.attrib = getattr(obs, "attrib", None)
 
     def attach_hydrator(self, hydrator) -> None:
         """Wire the residency tier in: `submit` prefetches on a doc's
@@ -209,6 +214,7 @@ class MergeScheduler:
             hydrator.oplog_lock = self._sync_lock
         if self.obs is not None:
             hydrator.recorder = self.obs.recorder
+            hydrator.attrib = getattr(self.obs, "attrib", None)
         for bank in self.banks:
             bank.snapshot_hook = hydrator.request_snapshot
 
@@ -486,6 +492,21 @@ class MergeScheduler:
         self.metrics.record_flush(
             shard, len(items), sum(i.n_ops for i in items), reason,
             dur_s=dur)
+        # live telemetry: admit->flush queue wait per merged item (the
+        # admission SLO), a flush-latency exemplar when this flush rode
+        # a sampled trace, and per-doc ops/device-time attribution
+        now_m = time.monotonic()
+        for it in items:
+            self.metrics.observe_queue_wait(
+                max(0.0, now_m - it.enqueued_at))
+        if obs is not None:
+            if fspan.sampled:
+                obs.exemplars.note("serve.flush", dur,
+                                   fspan.context().trace_id)
+            dev_share = dur / len(items)
+            for it in items:
+                obs.attrib.note("ops", doc=it.doc_id, n=it.n_ops)
+                obs.attrib.note("device_s", doc=it.doc_id, n=dev_share)
         if self.read_invalidate is not None:
             for it in items:
                 self.read_invalidate(it.doc_id)
@@ -642,6 +663,21 @@ class MergeScheduler:
         self.metrics.record_window(dispatches, n_docs, len(shards),
                                    mesh_docs=mesh_docs,
                                    padded_rows=padded_rows)
+        # live telemetry (mirrors _flush_items): queue waits, a flush
+        # exemplar off the window span, per-doc attribution
+        now_m = time.monotonic()
+        dev_share = dur / max(n_docs, 1)
+        for _s, _r, its in entries:
+            for it in its:
+                self.metrics.observe_queue_wait(
+                    max(0.0, now_m - it.enqueued_at))
+                if obs is not None:
+                    obs.attrib.note("ops", doc=it.doc_id, n=it.n_ops)
+                    obs.attrib.note("device_s", doc=it.doc_id,
+                                    n=dev_share)
+        if obs is not None and fspan.sampled:
+            obs.exemplars.note("serve.flush", dur,
+                               fspan.context().trace_id)
         return n_docs
 
     def _window_mesh_fallback(self, rows):

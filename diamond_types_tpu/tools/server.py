@@ -45,13 +45,24 @@ web_assets.py for the pages):
                             `?format=prom` switches to Prometheus text
                             exposition (text/plain; version=0.0.4) with
                             every counter/gauge/histogram as dt_*
-                            metrics (obs/prom.py)
+                            metrics (obs/prom.py); an Accept header
+                            asking for application/openmetrics-text (or
+                            `?format=openmetrics`) gets OpenMetrics 1.0
+                            with trace exemplars and the # EOF
+                            terminator
   GET  /debug/events        -> {"events": [...], "recorded", "dropped",
                             ...} — the flight recorder's bounded ring
                             of structured events (lease transitions,
                             fencing rejections, circuit opens,
                             evictions, queue-bound violations),
-                            oldest-first (obs/recorder.py)
+                            oldest-first (obs/recorder.py);
+                            `?since=<seq>` returns only events after
+                            that seq (incremental tailing)
+  GET  /debug/slo           -> obs/slo.py snapshot: per-objective burn
+                            rates (fast 5m / slow 1h) + alert states
+                            (ok|warning|burning)
+  GET  /debug/hot           -> obs/attrib.py snapshot: top-K docs and
+                            agents by ops/bytes/device_s/cache_misses
   POST /doc/{id}/at         body {"lv": n} -> {"text": ...} time travel
   POST /doc/{id}/history    body {"n": k} -> {"snapshots": [{"lv",
                             "text"}...]} oldest-first history strip; with
@@ -656,11 +667,13 @@ class SyncHandler(BaseHTTPRequestHandler):
         from .web_assets import (CRDT_HTML, EDITOR_HTML, INDEX_HTML,
                                  VIS_HTML)
 
-        parts = self.path.strip("/").split("/")
         if self.path == "/" or self.path == "":
             return self._send(200, INDEX_HTML.encode("utf8"),
                               "text/html; charset=utf-8")
         path = self.path.split("?", 1)[0]
+        # segment routing off the query-stripped path: /debug/events
+        # and /metrics take query parameters (?since=, ?format=)
+        parts = path.strip("/").split("/")
         if path == "/metrics":
             # serve/ scheduler counters (queue depths, flush sizes,
             # occupancy, evictions...) + replicate/ counters (leases,
@@ -681,21 +694,55 @@ class SyncHandler(BaseHTTPRequestHandler):
             qs = urllib.parse.parse_qs(
                 self.path.partition("?")[2], keep_blank_values=True)
             no_store = {"Cache-Control": "no-store"}
-            if qs.get("format", [""])[0] == "prom":
-                from ..obs.prom import CONTENT_TYPE, render_metrics
-                return self._send(200, render_metrics(doc).encode("utf8"),
-                                  CONTENT_TYPE, extra=no_store)
+            fmt = qs.get("format", [""])[0]
+            if fmt in ("prom", "openmetrics"):
+                from ..obs.prom import (CONTENT_TYPE,
+                                        OPENMETRICS_CONTENT_TYPE,
+                                        render_metrics)
+                # content negotiation: `?format=openmetrics` forces
+                # OpenMetrics 1.0; `?format=prom` honors an Accept
+                # header asking for it (how real Prometheus scrapers
+                # request exemplar-capable exposition)
+                accept = self.headers.get("Accept", "") or ""
+                om = (fmt == "openmetrics"
+                      or "application/openmetrics-text" in accept)
+                text = render_metrics(doc, openmetrics=om)
+                ctype = OPENMETRICS_CONTENT_TYPE if om else CONTENT_TYPE
+                return self._send(200, text.encode("utf8"), ctype,
+                                  extra=no_store)
             return self._send(200, json.dumps(doc).encode("utf8"),
                               extra=no_store)
         if parts[:1] == ["debug"]:
             obs = self.store.obs
+            no_store = {"Cache-Control": "no-store"}
             if obs is not None and len(parts) == 2 \
                     and parts[1] == "events":
+                # `?since=<seq>` tails the ring incrementally (obs-watch
+                # polls this instead of re-downloading every event)
+                qs = urllib.parse.parse_qs(
+                    self.path.partition("?")[2], keep_blank_values=True)
                 rec = obs.recorder
                 out = dict(rec.stats())
-                out["events"] = rec.dump()
+                try:
+                    since = int(qs.get("since", ["0"])[0] or 0)
+                except ValueError:
+                    return self._send(400, b'{"error": "bad since"}')
+                out["since"] = since
+                out["events"] = (rec.dump_since(since) if since > 0
+                                 else rec.dump())
                 return self._send(200, json.dumps(out).encode("utf8"),
-                                  extra={"Cache-Control": "no-store"})
+                                  extra=no_store)
+            if obs is not None and len(parts) == 2 and parts[1] == "slo":
+                # live SLO burn rates + alert states (pull-evaluated)
+                return self._send(
+                    200, json.dumps(obs.slo.snapshot()).encode("utf8"),
+                    extra=no_store)
+            if obs is not None and len(parts) == 2 and parts[1] == "hot":
+                # top-K hot-doc/agent attribution (bounded sketch)
+                return self._send(
+                    200,
+                    json.dumps(obs.attrib.snapshot()).encode("utf8"),
+                    extra=no_store)
             return self._send(404, b"{}")
         if parts and parts[0] == "replicate":
             node = self.store.replica
@@ -855,6 +902,11 @@ class SyncHandler(BaseHTTPRequestHandler):
             return self._send(404, b"{}")
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
+        obs = self.store.obs
+        if obs is not None and n and action in ("push", "edit", "ops"):
+            # per-doc request-byte attribution (the agent dimension is
+            # noted in the JSON handlers once the body names one)
+            obs.attrib.note("bytes", doc=doc_id, n=float(n))
         node = self.store.replica
         if node is not None and action in ("push", "edit", "ops"):
             # Fencing check first: a proxied mutation carries the lease
@@ -958,6 +1010,9 @@ class SyncHandler(BaseHTTPRequestHandler):
                     return self._send(400, b'{"error": "bad op"}')
             if not _agent_name_ok(req.get("agent")):
                 return self._send(400, b'{"error": "bad agent"}')
+            if obs is not None:
+                obs.attrib.note("ops", agent=req["agent"], n=len(ops))
+                obs.attrib.note("bytes", agent=req["agent"], n=float(n))
             with self.store.lock:
                 frontier = list(ol.cg.remote_to_local_frontier(
                     req.get("version") or []))
@@ -1063,6 +1118,11 @@ class SyncHandler(BaseHTTPRequestHandler):
                         self.store.reads.on_local_mutation(doc_id)
                     self.store.submit_merge(doc_id, applied,
                                             trace=self._trace_ctx())
+                    if obs is not None:
+                        for op in req.get("push") or []:
+                            a = op.get("agent")
+                            if isinstance(a, str) and a:
+                                obs.attrib.note("ops", agent=a)
             return self._send(200, json.dumps(
                 {"ops": out_ops, "version": ver}).encode("utf8"))
         if action == "history":

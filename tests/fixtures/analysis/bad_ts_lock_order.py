@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: telemetry ring lock-order violation.
+
+Acquires the oplog guard (30) while already holding the TimeSeries
+ring guard (`_ts_lock`, leaf, 50) — backwards against the canonical
+order: the telemetry locks are innermost leaves, taken by record_*
+double-writes while serve/read/replicate locks are already held, and
+nothing may be acquired under them.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureTelemetry:
+    def backwards(self, name, n):
+        with self._ts_lock:
+            with self.store.lock:
+                return self._windows[name] + n
