@@ -49,15 +49,19 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
 DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
-                    "storage", "read")
+                    "storage", "read", "obs")
 
 SEVERITY = {
     "lock-order": "error",
     "unsorted-locks": "error",
     "device-under-lock": "error",
     "unfenced-mutation": "error",
+    "unguarded-acquire": "error",
+    "metrics-schema-drift": "error",
     "jit-impurity": "warn",
     "jit-cache-key": "warn",
+    "blocking-call-under-lock": "warn",
+    "stale-suppression": "warn",
 }
 
 _SUPPRESS_RE = re.compile(
@@ -112,12 +116,20 @@ class CallSummary:
     `self_fenced` — names whose body contains a fencing token, so a
     call to them IS a fenced mutation (e.g. `_flush_items`).
     `mutators` — names whose body directly calls a doc-state mutator.
+    `blockers` — names whose body directly makes a blocking call
+    (sleep/fsync/network), for the one-hop blocking-call-under-lock
+    widening.
+    `metric_literals` — string literals appearing in
+    inc/observe/observe_latency calls anywhere in the linted tree,
+    the producer side of the metrics-schema exemplar join.
     """
 
     def __init__(self) -> None:
         self.dispatchers: Set[str] = set()
         self.self_fenced: Set[str] = set()
         self.mutators: Set[str] = set()
+        self.blockers: Set[str] = set()
+        self.metric_literals: Set[str] = set()
 
 
 def repo_root() -> str:
@@ -159,9 +171,18 @@ def _load(path: str) -> Optional[FileContext]:
 def build_summary(ctxs: List[FileContext]) -> CallSummary:
     from .rules.locks import DISPATCH_BASE
     from .rules.fencing import FENCE_TOKENS, MUTATOR_BASE
+    from .rules.dataflow import BLOCKING_BASE
     summary = CallSummary()
     for ctx in ctxs:
         for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("inc", "observe",
+                                           "observe_latency"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        summary.metric_literals.add(arg.value)
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue
@@ -185,6 +206,8 @@ def build_summary(ctxs: List[FileContext]) -> CallSummary:
                 summary.dispatchers.add(node.name)
             if calls & MUTATOR_BASE:
                 summary.mutators.add(node.name)
+            if calls & BLOCKING_BASE:
+                summary.blockers.add(node.name)
             if tokens & FENCE_TOKENS:
                 summary.self_fenced.add(node.name)
     return summary
@@ -202,12 +225,45 @@ def run_lint(paths: Optional[List[str]] = None,
     summary = build_summary(ctxs)
     violations: List[Violation] = []
     for ctx in ctxs:
+        # which suppression comments actually absorbed a finding, by
+        # line — the complement is the stale-suppression report
+        fired: Dict[int, Set[str]] = {}
         for rule_fn in RULES:
             for v in rule_fn(ctx, summary):
-                if v.rule in disabled or ctx.suppressed(v):
-                    continue
                 v.severity = SEVERITY.get(v.rule, v.severity)
+                # suppression check BEFORE the disable check: a
+                # comment shielding a --disable'd rule still shields
+                # something and must not be reported stale
+                if ctx.suppressed(v):
+                    fired.setdefault(v.line, set()).add(v.rule)
+                    continue
+                if v.rule in disabled:
+                    continue
                 violations.append(v)
+        if "stale-suppression" in disabled or ctx.skip_file:
+            continue
+        for line, rules in sorted(ctx.suppressions.items()):
+            hit = fired.get(line, set())
+            if "*" in rules:
+                if not hit:
+                    violations.append(Violation(
+                        rule="stale-suppression", path=ctx.rel,
+                        line=line, severity="warn",
+                        message="`# dt-lint: ignore` suppresses "
+                                "nothing on this line — delete it, "
+                                "or it will hide the next real "
+                                "finding here"))
+                continue
+            unused = sorted(r for r in rules if r not in hit)
+            if unused:
+                violations.append(Violation(
+                    rule="stale-suppression", path=ctx.rel,
+                    line=line, severity="warn",
+                    message=(f"`# dt-lint: ignore[{', '.join(unused)}]`"
+                             f" no longer suppresses anything — the "
+                             f"finding it silenced is gone; delete "
+                             f"the comment (stale suppressions hide "
+                             f"the next real finding)")))
     violations.sort(key=lambda v: (v.path, v.line, v.rule))
     # zero-filled so dt_lint_violations_total{rule} exports one sample
     # per rule even on a clean tree

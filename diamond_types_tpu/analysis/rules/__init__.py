@@ -8,10 +8,14 @@ serve/README.md "Concurrency invariants".
 
 from __future__ import annotations
 
+from .dataflow import check_dataflow
 from .fencing import check_fencing
 from .jit_purity import check_jit_purity
 from .locks import check_locks
+from .metrics_schema import check_metrics_schema
 
-RULES = (check_locks, check_fencing, check_jit_purity)
+RULES = (check_locks, check_fencing, check_jit_purity,
+         check_dataflow, check_metrics_schema)
 
-__all__ = ["RULES", "check_locks", "check_fencing", "check_jit_purity"]
+__all__ = ["RULES", "check_locks", "check_fencing", "check_jit_purity",
+           "check_dataflow", "check_metrics_schema"]

@@ -97,4 +97,10 @@ class Observability:
         lint = last_report()
         if lint is not None:
             out["lint"] = lint
+        # the model-checker verdict rides the same pattern: present
+        # once a dt-explore run published in this process
+        from ..analysis.explore import last_report as explore_report
+        explore = explore_report()
+        if explore is not None:
+            out["explore"] = explore
         return out

@@ -313,6 +313,21 @@ def _render_obs(b: _Builder, obs: dict) -> None:
                   labels={"rule": rule})
         b.add("dt_lint_files", "gauge", lint.get("files", 0))
         b.add("dt_lint_ok", "gauge", 1 if lint.get("ok") else 0)
+    explore = obs.get("explore") or {}
+    if explore:
+        lb = {"scenario": explore.get("scenario", "")}
+        b.add("dt_explore_ok", "gauge",
+              1 if explore.get("ok") else 0, labels=lb)
+        b.add("dt_explore_complete", "gauge",
+              1 if explore.get("complete") else 0, labels=lb)
+        b.add("dt_explore_depth", "gauge",
+              explore.get("depth", 0), labels=lb)
+        b.add("dt_explore_states_total", "counter",
+              explore.get("states", 0), labels=lb)
+        b.add("dt_explore_states_per_second", "gauge",
+              explore.get("states_per_s", 0.0), labels=lb)
+        b.add("dt_explore_violations_total", "counter",
+              explore.get("violations", 0), labels=lb)
     # live telemetry tier: SLO burn-rate gauges, windowed rates, and
     # the top-K hot-doc/agent attribution (all bounded cardinality)
     slo = obs.get("slo") or {}

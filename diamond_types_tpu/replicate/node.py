@@ -81,10 +81,18 @@ class ReplicaNode:
                  takeover_after_s: Optional[float] = None,
                  faults: Optional[FaultInjector] = None,
                  journal_prefix: Optional[str] = None,
-                 obs=None) -> None:
+                 obs=None, clock=None, table=None,
+                 journal=None) -> None:
         self.store = store
         self.self_id = self_id
-        self.started_at = time.monotonic()
+        # clock/table/journal are dependency seams: the model checker
+        # (analysis/explore) substitutes a virtual clock, a synchronous
+        # simulated transport, and an in-memory journal so the real
+        # protocol code runs under exhaustive scheduling. Production
+        # callers leave all three None and get wall time + PeerTable +
+        # file-backed ReplicaJournal, exactly as before.
+        self.clock = time.monotonic if clock is None else clock
+        self.started_at = self.clock()
         # how long a peer must stay continuously down before it is
         # declared DEAD and ownership reassigns its docs; defaults to
         # the lease TTL so a takeover can only be PROPOSED after the
@@ -94,13 +102,20 @@ class ReplicaNode:
                                  else takeover_after_s)
         self.metrics = ReplicationMetrics(self_id)
         self.faults = faults
-        self.table = PeerTable(self_id, peer_addrs, timeout_s=timeout_s,
-                               fail_threshold=fail_threshold, seed=seed,
-                               backoff_base_s=backoff_base_s,
-                               backoff_cap_s=backoff_cap_s,
-                               faults=faults, metrics=self.metrics)
+        if table is not None:
+            self.table = table
+            self.table.metrics = self.metrics
+        else:
+            self.table = PeerTable(self_id, peer_addrs,
+                                   timeout_s=timeout_s,
+                                   fail_threshold=fail_threshold,
+                                   seed=seed,
+                                   backoff_base_s=backoff_base_s,
+                                   backoff_cap_s=backoff_cap_s,
+                                   faults=faults, metrics=self.metrics)
         self.leases = LeaseManager(self_id, ttl_s=lease_ttl_s,
-                                   metrics=self.metrics)
+                                   metrics=self.metrics,
+                                   clock=self.clock)
         # obs.Observability bundle (usually the DocStore's, via
         # attach_replication): spans on proxy/handoff/quorum, flight
         # recorder for lease/fencing/circuit events
@@ -115,8 +130,11 @@ class ReplicaNode:
         self.journal: Optional[ReplicaJournal] = None
         self.rejoining = False
         incarnation = 1
-        if journal_prefix is not None:
+        if journal is not None:
+            self.journal = journal
+        elif journal_prefix is not None:
             self.journal = ReplicaJournal(journal_prefix)
+        if self.journal is not None:
             self.rejoining = self.journal.has_prior_state()
             incarnation = self.journal.restored_incarnation() + 1
             self.journal.note_incarnation(incarnation)
@@ -161,7 +179,7 @@ class ReplicaNode:
         not collapse each side's host set to itself), down past it →
         DEAD (out of the universe; its docs reassign — safely, because
         reassignment still needs a quorum)."""
-        now = time.monotonic()
+        now = self.clock()
         for p in self.table.peer_ids():
             self.membership.note_health(
                 p, self.table.down_duration(p, now),
@@ -405,7 +423,7 @@ class ReplicaNode:
         """Body of `GET /replicate/ping` — health ack + gossip
         piggyback (the probe loop is the gossip transport)."""
         out = {"ok": True, "id": self.self_id,
-               "uptime_s": round(time.monotonic() - self.started_at, 3),
+               "uptime_s": round(self.clock() - self.started_at, 3),
                "incarnation": self.membership.self_incarnation,
                "view_version": self.membership.view_version,
                "rejoining": self.rejoining,
@@ -548,7 +566,7 @@ class ReplicaNode:
     # ---- docs listing (for anti-entropy peers) ---------------------------
 
     def docs_json(self) -> dict:
-        now = time.monotonic()
+        now = self.clock()
         doc_ids = self.store.doc_ids()
         # follower-read frontier advertisement: our frontier per
         # IN-MEMORY doc (not-yet-loaded .dt files aren't worth a load

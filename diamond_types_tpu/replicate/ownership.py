@@ -100,13 +100,14 @@ class Lease:
                  "granted_at")
 
     def __init__(self, doc_id: str, holder: str, epoch: int,
-                 state: str, expires_at: float) -> None:
+                 state: str, expires_at: float,
+                 now: Optional[float] = None) -> None:
         self.doc_id = doc_id
         self.holder = holder
         self.epoch = epoch
         self.state = state
         self.expires_at = expires_at     # monotonic, local clock
-        self.granted_at = time.monotonic()
+        self.granted_at = time.monotonic() if now is None else now
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (time.monotonic() if now is None else now) \
@@ -127,10 +128,15 @@ class LeaseManager:
     state: the promise table and the per-doc fencing floors."""
 
     def __init__(self, self_id: str, ttl_s: float = 2.0,
-                 metrics: Optional[ReplicationMetrics] = None) -> None:
+                 metrics: Optional[ReplicationMetrics] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.self_id = self_id
         self.ttl_s = ttl_s
         self.metrics = metrics
+        # time source for every lease decision; the model checker
+        # (analysis/explore) injects a virtual clock here
+        self.clock: Callable[[], float] = \
+            time.monotonic if clock is None else clock
         self.leases: Dict[str, Lease] = {}
         # per-doc fencing floor: highest epoch ever promised/observed
         self.max_epoch: Dict[str, int] = {}
@@ -176,7 +182,7 @@ class LeaseManager:
     def _log_activation_locked(self, doc_id: str, epoch: int) -> None:
         self.activation_log.append(
             {"doc": doc_id, "epoch": epoch, "holder": self.self_id,
-             "t": time.monotonic()})
+             "t": self.clock()})
         if len(self.activation_log) > _ACTIVATION_LOG_MAX:
             del self.activation_log[:_ACTIVATION_LOG_MAX // 4]
 
@@ -209,7 +215,7 @@ class LeaseManager:
                 if cur is None or p["epoch"] > cur[0]:
                     self.promised[doc] = (int(p["epoch"]),
                                           str(p["holder"]))
-            now = time.monotonic()
+            now = self.clock()
             for doc, info in journal.restored_leases().items():
                 if doc in self.leases:
                     continue
@@ -218,7 +224,8 @@ class LeaseManager:
                     else str(info.get("state", ACTIVE))
                 # expires_at = now: an expired hint, never admissible
                 self.leases[doc] = Lease(doc, holder,
-                                         int(info["epoch"]), state, now)
+                                         int(info["epoch"]), state, now,
+                                         now=now)
         self.journal = journal
         return n
 
@@ -243,7 +250,8 @@ class LeaseManager:
         with self.lock:
             lease = self.leases.get(doc_id)
             if lease is None or lease.state == RELEASED \
-                    or lease.expired(now):
+                    or lease.expired(self.clock() if now is None
+                                     else now):
                 return None
             return lease.holder
 
@@ -269,7 +277,7 @@ class LeaseManager:
         re-validation. Returns False while another host's unexpired
         lease stands, during our own outbound handoff, while a quorum
         round is lost, or when our lease has been fenced off."""
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         plan = self._admit_or_plan(doc_id, is_desired_owner, now)
         if plan is True or plan is False:
             return plan
@@ -342,7 +350,8 @@ class LeaseManager:
                     not in (None, (epoch, self.self_id))):
                 return False
             self.leases[doc_id] = Lease(doc_id, self.self_id, epoch,
-                                        ACTIVE, now + self.ttl_s)
+                                        ACTIVE, now + self.ttl_s,
+                                        now=now)
             self._note_epoch_locked(doc_id, epoch)
             self._log_activation_locked(doc_id, epoch)
             self._bump("takeovers" if takeover else "acquires")
@@ -363,7 +372,7 @@ class LeaseManager:
         survives restarts via the journal). Granting also raises the
         fencing floor, so a superseded local lease self-revokes.
         Returns (ok, reason)."""
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         with self.lock:
             if epoch < self.max_epoch.get(doc_id, 0):
                 return False, "stale_epoch"
@@ -400,7 +409,7 @@ class LeaseManager:
         shorten it). Equal epoch + DIFFERING holders is the arbitration
         event documented in the module docstring: lexically smaller
         holder id wins, counted in `leases.tie_breaks`."""
-        now = time.monotonic()
+        now = self.clock()
         with self.lock:
             cur = self.leases.get(doc_id)
             if cur is not None:
@@ -420,7 +429,8 @@ class LeaseManager:
                         return       # incumbent (smaller id) wins
                     # incoming smaller id wins: fall through, replace
             self.leases[doc_id] = Lease(
-                doc_id, holder, epoch, state, now + max(ttl_s, 0.0))
+                doc_id, holder, epoch, state, now + max(ttl_s, 0.0),
+                now=now)
             self._note_epoch_locked(doc_id, epoch)
 
     def accept_grant(self, doc_id: str, epoch: int,
@@ -428,7 +438,7 @@ class LeaseManager:
         """Remote handoff step 1 (receiver): record the offered lease
         as GRANTED-not-active. Idempotent; refuses stale epochs (both
         vs the current lease and vs the fencing floor)."""
-        now = time.monotonic()
+        now = self.clock()
         with self.lock:
             if epoch < self.max_epoch.get(doc_id, 0):
                 return False
@@ -438,7 +448,8 @@ class LeaseManager:
                              and cur.epoch == epoch):
                 return False
             self.leases[doc_id] = Lease(doc_id, self.self_id, epoch,
-                                        GRANTED, now + max(ttl_s, 0.0))
+                                        GRANTED, now + max(ttl_s, 0.0),
+                                        now=now)
             self._note_epoch_locked(doc_id, epoch)
             self._event("lease_granted", doc_id, epoch)
             return True
@@ -448,7 +459,7 @@ class LeaseManager:
         Idempotent (duplicate activate messages are harmless). The
         quorum round for the new epoch runs BEFORE this (node-level),
         so activation here is purely local state."""
-        now = time.monotonic()
+        now = self.clock()
         with self.lock:
             cur = self.leases.get(doc_id)
             if cur is None or cur.holder != self.self_id \
@@ -496,10 +507,11 @@ class LeaseManager:
     def finish_handoff(self, doc_id: str, new_holder: str,
                        new_epoch: int) -> None:
         """Local release + record the new owner's active lease."""
-        now = time.monotonic()
+        now = self.clock()
         with self.lock:
             self.leases[doc_id] = Lease(doc_id, new_holder, new_epoch,
-                                        ACTIVE, now + self.ttl_s)
+                                        ACTIVE, now + self.ttl_s,
+                                        now=now)
             self._note_epoch_locked(doc_id, new_epoch)
             self._bump("releases")
             self._event("lease_released", doc_id, new_epoch,
@@ -516,13 +528,13 @@ class LeaseManager:
             if lease is not None and lease.holder == self.self_id \
                     and lease.state in _HANDOFF_STATES:
                 lease.state = ACTIVE
-                lease.expires_at = time.monotonic() + self.ttl_s
+                lease.expires_at = self.clock() + self.ttl_s
                 self._event("handoff_aborted", doc_id, lease.epoch)
 
     # ---- export ----------------------------------------------------------
 
     def as_json(self) -> dict:
-        now = time.monotonic()
+        now = self.clock()
         with self.lock:
             return {d: lease.as_json(now)
                     for d, lease in sorted(self.leases.items())}

@@ -45,6 +45,13 @@ from .pages import PAGE_SIZE, PagedDocFile, PagedStore
 from .store import StorageError
 
 
+# the tier's full counter surface, module-level so the dt-lint
+# metrics-schema-drift rule (analysis/rules/metrics_schema.py) can
+# cross-reference producer bumps against it without importing a class
+TIER_KEYS = ("saves", "loads", "fresh_docs", "compactions",
+             "salvaged_wal", "quarantines", "slow_loads")
+
+
 class DocQuarantined(StorageError):
     """Typed per-doc rejection: the doc's durable home is unreadable
     (or its hydration budget is exhausted). Only THIS doc is affected
@@ -107,9 +114,7 @@ class TieredStore:
         self._tier_lock = make_lock("tier.table", "io")
         self._doc_locks: Dict[str, object] = {}
         self.quarantined: Dict[str, str] = {}
-        self._counters = {k: 0 for k in (
-            "saves", "loads", "fresh_docs", "compactions",
-            "salvaged_wal", "quarantines", "slow_loads")}
+        self._counters = {k: 0 for k in TIER_KEYS}
 
     # ---- bookkeeping -----------------------------------------------------
 

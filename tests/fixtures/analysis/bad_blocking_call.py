@@ -1,0 +1,15 @@
+"""Seeded dt-lint fixture: blocking call under a hot-path lock.
+
+Sleeps while holding the scheduler's global lock — every submit on
+every shard stalls behind the sleep. Never imported; parsed by the
+lint engine only.
+"""
+
+import time
+
+
+class FixtureScheduler:
+    def backoff_holding_lock(self, delay_s):
+        with self.lock:
+            time.sleep(delay_s)
+            return delay_s

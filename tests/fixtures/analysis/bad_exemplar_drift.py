@@ -1,0 +1,10 @@
+"""Seeded dt-lint fixture: exemplar family with no producer.
+
+Maps a prom histogram to a TimeSeries family no producer ever writes
+— the exemplar join would silently return nothing forever. Never
+imported; parsed by the lint engine only.
+"""
+
+_EXEMPLAR_FAMILIES = {
+    "dt_fixture_latency_seconds": "serve.bogus_family",
+}

@@ -1,0 +1,11 @@
+"""Seeded dt-lint fixture: metrics-schema drift.
+
+Bumps a replication counter key that ReplicationMetrics._GROUPS does
+not declare — prom zero-fill and the repl.* time-series table would
+never export it. Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureReporter:
+    def note_acquire(self):
+        self.metrics.bump("leases", "acquries")

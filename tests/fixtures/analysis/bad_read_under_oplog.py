@@ -1,0 +1,17 @@
+"""Historical-bug fixture: the pre-repair read path.
+
+Re-expresses the device-under-lock bug the concurrency-analyzer PR
+caught in the wild: bank.text synced the doc to the device while
+still holding the store's oplog guard, so every submit and oplog
+reader stalled behind a device round-trip. The repaired bank splits
+the oplog read from the device fetch; this fixture pins the detector
+that caught the original. Never imported; parsed by the lint engine
+only.
+"""
+
+
+class FixtureBank:
+    def text(self, doc_id):
+        with self.store.lock:
+            self.sync_doc(doc_id, None)
+            return self.checkout_text(doc_id)

@@ -1,0 +1,14 @@
+"""Seeded dt-lint fixture: bare .acquire() with no try/finally.
+
+Acquires a shard lock imperatively and releases it on the straight
+path only — any exception in between leaves the lock held forever.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureScheduler:
+    def grab_and_work(self, s):
+        lk = self._shard_locks[s]
+        lk.acquire()
+        self.do_work(s)
+        lk.release()

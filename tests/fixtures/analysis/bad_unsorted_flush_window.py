@@ -1,0 +1,19 @@
+"""Historical-bug fixture: the pre-repair _flush_window acquisition.
+
+Re-expresses the lock-order bug the concurrency-analyzer PR caught in
+the wild: the mesh flush window grabbed its per-shard device locks in
+window order, not sorted order, so two windows over the same shards
+could deadlock. The repaired scheduler iterates a sorted shard list;
+this fixture pins the detector that caught the original. Never
+imported; parsed by the lint engine only.
+"""
+
+import contextlib
+
+
+class FixtureScheduler:
+    def _flush_window(self, win):
+        with contextlib.ExitStack() as stack:
+            for s in win.shards:
+                stack.enter_context(self._device_locks[s])
+            return self.dispatch(win)

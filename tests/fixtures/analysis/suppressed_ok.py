@@ -1,8 +1,9 @@
 """Seeded dt-lint fixture: violations silenced by suppressions.
 
 Same shapes as the bad_* fixtures but every finding carries a
-same-line `# dt-lint: ignore[rule]` — the file must lint clean.
-Never imported; parsed by the lint engine only.
+same-line ignore[rule] comment — the file must lint clean (and every
+suppression absorbs a real finding, so the stale-suppression audit
+stays quiet too). Never imported; parsed by the lint engine only.
 """
 
 

@@ -60,6 +60,15 @@ def _witness_clean():
     ("bad_unfenced_mutation.py", "unfenced-mutation", 15, "error"),
     ("bad_jit_impurity.py", "jit-impurity", 14, "warn"),
     ("bad_jit_cache_key.py", "jit-cache-key", 13, "warn"),
+    ("bad_blocking_call.py", "blocking-call-under-lock", 14, "warn"),
+    ("bad_unguarded_acquire.py", "unguarded-acquire", 12, "error"),
+    ("bad_metrics_drift.py", "metrics-schema-drift", 11, "error"),
+    ("bad_exemplar_drift.py", "metrics-schema-drift", 9, "error"),
+    ("bad_stale_suppression.py", "stale-suppression", 11, "warn"),
+    # the two historical bugs PR 7's tree repairs fixed, re-expressed
+    # as seeded fixtures so the rules that caught them stay honest
+    ("bad_unsorted_flush_window.py", "unsorted-locks", 18, "error"),
+    ("bad_read_under_oplog.py", "device-under-lock", 16, "error"),
 ])
 def test_rule_fires_on_seeded_fixture(fixture, rule, line, severity):
     report = _lint_fixture(fixture)
