@@ -46,13 +46,18 @@ def _fingerprint(world: SimWorld, counts: Dict[str, int]) -> str:
                  "cut": sorted(sorted(p) for p in world.cut_links),
                  "counts": dict(sorted(counts.items())),
                  "edit_seq": world.edit_seq,
+                 "acked": {d: list(v)
+                           for d, v in sorted(world.acked.items())},
                  "last_msg": {k: v for k, v in
                               sorted(world.last_lease_msg.items())},
                  "nodes": {}}
     for n in world.node_ids:
         journal = world.journals[n].fingerprint()
+        pending = {d: list(v) for d, v in
+                   sorted(world.stores[n].pending.items())}
         if n in world.crashed:
-            doc["nodes"][n] = {"crashed": True, "journal": journal}
+            doc["nodes"][n] = {"crashed": True, "journal": journal,
+                               "pending": pending}
             continue
         node = world.nodes[n]
         mgr = node.leases
@@ -73,6 +78,8 @@ def _fingerprint(world: SimWorld, counts: Dict[str, int]) -> str:
             "merged": sorted(node.merged_docs),
             "frontiers": frontiers,
             "journal": journal,
+            "pending": pending,
+            "overrides": node.overrides.as_json(),
         }
     blob = json.dumps(doc, sort_keys=True, default=str)
     return hashlib.sha1(blob.encode("utf8")).hexdigest()

@@ -37,6 +37,7 @@ ALL_INVARIANTS = (
     "own-lease-stability",  # peer echo never shortens our ACTIVE lease
     "tie-break-direction",  # equal-epoch arbitration keeps the smaller id
     "convergence",          # byte-identical state after quiesce (leaves)
+    "no-acked-loss",        # every acked (queued) op survives to quiesce
 )
 
 
@@ -175,11 +176,16 @@ class InvariantChecker:
     # ---- leaf-only quiescence check (mutates the world) ----
     def check_convergence(self, max_rounds: int = 6) \
             -> Optional[Violation]:
-        """Heal every link, restart every crashed node, run bounded
-        anti-entropy to fixpoint: all replicas must reach byte-identical
-        text and identical frontiers. Run only at leaf states — it
-        consumes the world."""
-        if "convergence" not in self.names:
+        """Heal every link, restart every crashed node, flush every
+        surviving admission queue, run bounded anti-entropy to
+        fixpoint: all replicas must reach byte-identical text and
+        identical frontiers, and every op still on the acked ledger
+        must appear in the converged state (no-acked-loss — queued
+        work a completed migration evicted without draining is exactly
+        what this catches). Run only at leaf states — it consumes the
+        world."""
+        if "convergence" not in self.names \
+                and "no-acked-loss" not in self.names:
             return None
         w = self.world
         for pair in list(w.cut_links):
@@ -187,6 +193,10 @@ class InvariantChecker:
             w.heal(a, b)
         for n in list(w.crashed):
             w.restart(n)
+        # surviving queues eventually flush; only ops DROPPED earlier
+        # (not merely still queued) can violate no-acked-loss
+        for n in w.node_ids:
+            w.stores[n].scheduler.drain()
         docs = set()
         for n in w.node_ids:
             docs |= set(w.stores[n].docs)
@@ -197,18 +207,29 @@ class InvariantChecker:
                 break
             for n in w.node_ids:
                 w.nodes[n].antientropy.run_round()
-        if not self._frontiers_equal(docs):
-            return Violation(
-                "convergence",
-                f"frontiers still differ after {max_rounds} quiesce "
-                f"rounds")
-        for d in sorted(docs):
-            texts = {n: w.text_of(n, d) for n in w.node_ids}
-            if len(set(texts.values())) > 1:
+        if "convergence" in self.names:
+            if not self._frontiers_equal(docs):
                 return Violation(
                     "convergence",
-                    f"doc {d} texts diverge after quiesce: "
-                    f"{ {n: t[:24] for n, t in texts.items()} }")
+                    f"frontiers still differ after {max_rounds} "
+                    f"quiesce rounds")
+            for d in sorted(docs):
+                texts = {n: w.text_of(n, d) for n in w.node_ids}
+                if len(set(texts.values())) > 1:
+                    return Violation(
+                        "convergence",
+                        f"doc {d} texts diverge after quiesce: "
+                        f"{ {n: t[:24] for n, t in texts.items()} }")
+        if "no-acked-loss" in self.names:
+            for d, chars in sorted(w.acked.items()):
+                for n in w.node_ids:
+                    text = w.text_of(n, d)
+                    missing = [c for c in chars if c not in text]
+                    if missing:
+                        return Violation(
+                            "no-acked-loss",
+                            f"doc {d}: acked ops {missing} absent "
+                            f"from {n}'s converged text {text[:24]!r}")
         return None
 
     def _frontiers_equal(self, docs) -> bool:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+from ...replicate.ownership import ACTIVE
 from .world import SimWorld
 
 # footprint token meaning "conflicts with everything"
@@ -47,10 +48,10 @@ class Action:
             return "tick"
         if self.op in ("cut", "heal"):
             return f"{self.op}({self.node},{self.peer})"
-        if self.op == "edit":
-            return f"edit({self.node},{self.doc})"
-        if self.op == "acquire":
-            return f"acquire({self.node},{self.doc})"
+        if self.op in ("edit", "qedit", "acquire"):
+            return f"{self.op}({self.node},{self.doc})"
+        if self.op == "migrate":
+            return f"migrate({self.node},{self.peer},{self.doc})"
         return f"{self.op}({self.node})"
 
     def __repr__(self) -> str:
@@ -86,12 +87,27 @@ class Action:
             return False
         if op == "dup":
             return self.node in world.last_lease_msg
+        if op in ("qedit", "migrate"):
+            # only the current ACTIVE holder acks queued writes or
+            # initiates a migration (the rebalancer runs on the owner);
+            # the TARGET may be crashed/cut — that is the abort path
+            l = world.nodes[self.node].leases.get(self.doc)
+            return l is not None and l.holder == self.node \
+                and l.state == ACTIVE
+        if op == "flush":
+            return bool(world.stores[self.node].pending)
         return True
 
     def apply(self, world: SimWorld) -> None:
         op = self.op
         if op == "edit":
             world.edit(self.node, self.doc)
+        elif op == "qedit":
+            world.qedit(self.node, self.doc)
+        elif op == "flush":
+            world.stores[self.node].scheduler.drain()
+        elif op == "migrate":
+            world.migrate(self.node, self.peer, self.doc)
         elif op == "acquire":
             world.nodes[self.node].leases.ensure_local(self.doc, True)
         elif op == "step":
@@ -120,7 +136,7 @@ class Action:
         relation (disjoint footprints commute). Environment actions and
         anything that can touch every node are ALL — conservative is
         sound; it only costs reduction."""
-        if self.op == "edit":
+        if self.op in ("edit", "qedit", "flush"):
             return frozenset({f"{self.node}:oplog"})
         return frozenset({ALL})
 
@@ -258,3 +274,32 @@ _register(Scenario(
                 "reachable: arbitration must be deterministic "
                 "(lexically smaller holder wins) on every host. "
                 "single-active is deliberately not checked here."))
+
+_register(Scenario(
+    "migration", ("n1", "n2", "n3"), ("d0",), quorum=True,
+    # pre-state: n1 owns d0 with one acked-but-queued write sitting in
+    # its admission queue — the op the drain barrier must not lose
+    setup=_acts(("acquire", "n1", None, "d0"),
+                ("qedit", "n1", None, "d0")),
+    actions=_acts(
+        ("qedit", "n1", None, "d0"),
+        ("flush", "n1"),
+        ("migrate", "n1", "n2", "d0"),
+        ("step", "n1"), ("step", "n2"),
+        ("ae", "n1"), ("ae", "n2"),
+        ("tick",),
+        ("cut", "n1", "n2"), ("heal", "n1", "n2"),
+        ("crash", "n2"), ("restart", "n2"),
+        ("dup", "n2"),
+    ),
+    bounds={"qedit": 1, "flush": 1, "migrate": 2, "step": 1, "ae": 1,
+            "tick": 2, "cut": 1, "heal": 1, "crash": 1, "restart": 1,
+            "dup": 1},
+    invariants=("single-active", "promise-exclusivity",
+                "floor-monotonic", "floor-coverage",
+                "no-acked-loss", "convergence"),
+    description="elastic-mesh live migration (override + grant -> "
+                "drain -> transfer -> activate) under crash, "
+                "partition and duplicate delivery: no interleaving "
+                "may lose an acknowledged op or activate two owners; "
+                "aborts must leave the doc owned at the source."))

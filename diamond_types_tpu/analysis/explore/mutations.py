@@ -92,6 +92,16 @@ def _mut_tiebreak_invert(node) -> None:
         node.leases, own_guard=True, smaller_wins=False)
 
 
+def _mut_drain_skip(world) -> None:
+    # the handoff's drain barrier no-ops: the final transfer patch is
+    # cut while acked writes still sit in the admission queue, and the
+    # source's post-migration eviction then drops them on the floor.
+    # Applied to the STORES (which survive simulated crash/restart),
+    # so a restart cannot cure it.
+    for store in world.stores.values():
+        store.scheduler.drain = lambda: None
+
+
 MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
     Mutation(
         "floor-drop", scenario="renewal",
@@ -123,4 +133,13 @@ MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
                     "LARGER holder: hosts that see the two claims in "
                     "different orders resolve to different winners",
         apply_node=_mut_tiebreak_invert, depth=3),
+    Mutation(
+        "drain-skip", scenario="migration",
+        expect=("no-acked-loss",),
+        description="the migration handoff skips the drain barrier: "
+                    "the transfer patch misses still-queued acked "
+                    "writes and the source's post-migration eviction "
+                    "loses them — an acknowledged op vanishes from "
+                    "the converged state",
+        apply_world=_mut_drain_skip, depth=2),
 )}

@@ -44,6 +44,7 @@ class MemJournal:
         self.max_epochs: Dict[str, int] = {}
         self.promises: Dict[str, dict] = {}
         self.leases: Dict[str, dict] = {}
+        self.overrides: Dict[str, dict] = {}
         self._dirty = False
 
     # -- writes (mirror ReplicaJournal's semantics) --
@@ -72,6 +73,13 @@ class MemJournal:
         self.leases.pop(doc_id, None)
         self._dirty = True
 
+    def note_override(self, doc_id: str, target, ver: int) -> None:
+        # same LWW-by-version fold as ReplicaJournal._apply
+        cur = self.overrides.get(doc_id)
+        if cur is None or int(ver) >= int(cur.get("ver", 0)):
+            self.overrides[doc_id] = {"target": target, "ver": int(ver)}
+        self._dirty = True
+
     def record(self, *a, **k) -> None:
         self._dirty = True
 
@@ -91,6 +99,9 @@ class MemJournal:
     def restored_leases(self) -> Dict[str, dict]:
         return {d: dict(l) for d, l in self.leases.items()}
 
+    def restored_overrides(self) -> Dict[str, dict]:
+        return {d: dict(o) for d, o in self.overrides.items()}
+
     def has_prior_state(self) -> bool:
         return self._dirty
 
@@ -99,22 +110,60 @@ class MemJournal:
 
     def fingerprint(self) -> dict:
         return {"inc": self.incarnation, "floors": self.max_epochs,
-                "promises": self.promises, "leases": self.leases}
+                "promises": self.promises, "leases": self.leases,
+                "overrides": self.overrides}
+
+
+class _SimScheduler:
+    """MergeScheduler duck-type exposing the one seam `node.handoff`'s
+    drain phase uses: `drain()` flushes every queued (acknowledged)
+    write into the oplog — the admission queue the real drain barrier
+    empties before the final transfer patch is cut."""
+
+    def __init__(self, store: "MemStore") -> None:
+        self.store = store
+
+    def drain(self) -> None:
+        for doc_id in sorted(self.store.pending):
+            self.store.flush_pending(doc_id)
 
 
 class MemStore:
-    """Minimal DocStore duck-type: real OpLogs, no scheduler, no
-    device tier. Auto-creates docs on first touch (the anti-entropy
-    union walk relies on that)."""
+    """Minimal DocStore duck-type: real OpLogs, no scheduler/device
+    tier beyond the `_SimScheduler` drain seam. Auto-creates docs on
+    first touch (the anti-entropy union walk relies on that).
+
+    `pending` models the admission queue: `qedit` actions ACK a write
+    to the client but only queue it here; `flush`/drain moves it into
+    the oplog. The queue is volatile — a crash loses it (and the model
+    retracts those acks: client and server died together; queue
+    durability is the storage soak's separately-tested property)."""
 
     def __init__(self, owner_id: str) -> None:
         from ..witness import make_lock
+        self.owner_id = owner_id
         self.docs: Dict[str, OpLog] = {}
         self.lock = make_lock(f"sim.store.{owner_id}", "oplog",
                               reentrant=True)
         self.replica = None
         self.reads = None
         self.merge_submissions: List[Tuple[str, int]] = []
+        self.pending: Dict[str, List[str]] = {}
+        self.scheduler = _SimScheduler(self)
+
+    def queue_edit(self, doc_id: str, ch: str) -> None:
+        self.pending.setdefault(doc_id, []).append(ch)
+
+    def flush_pending(self, doc_id: str) -> None:
+        chars = self.pending.pop(doc_id, [])
+        if not chars:
+            return
+        ol = self.get(doc_id)
+        with self.lock:
+            agent = ol.get_or_create_agent_id(
+                f"agent-{self.owner_id}")
+            for ch in chars:
+                ol.add_insert(agent, 0, ch)
 
     def get(self, doc_id: str) -> OpLog:
         ol = self.docs.get(doc_id)
@@ -305,6 +354,10 @@ class SimWorld:
         self.down_since: Dict[Tuple[str, str], float] = {}
         self.events: List[dict] = []
         self.edit_seq = 0
+        # ghost ledger for the no-acked-loss invariant: every char the
+        # model has acknowledged to a client, per doc (crash retracts
+        # the crashed node's still-queued chars — see MemStore.pending)
+        self.acked: Dict[str, List[str]] = {}
         # last lease message delivered to each node, for the `dup`
         # (duplicate delivery) action
         self.last_lease_msg: Dict[str, dict] = {}
@@ -338,7 +391,20 @@ class SimWorld:
         return node
 
     def crash(self, node_id: str) -> None:
-        """Lose the node's in-memory state; keep journal + oplog."""
+        """Lose the node's in-memory state; keep journal + oplog. The
+        admission queue is in-memory too: queued chars are gone, and
+        their acks are retracted from the ghost ledger (the clients
+        died with the server; queue durability is out of scope)."""
+        store = self.stores[node_id]
+        for doc_id, chars in list(store.pending.items()):
+            acked = self.acked.get(doc_id)
+            if acked:
+                for ch in chars:
+                    try:
+                        acked.remove(ch)
+                    except ValueError:
+                        pass
+        store.pending.clear()
         self.crashed.add(node_id)
         self.nodes.pop(node_id, None)
         for other in self.node_ids:
@@ -439,6 +505,30 @@ class SimWorld:
             ol.add_insert(agent, 0,
                           chr(ord("a") + self.edit_seq % 26))
         self.edit_seq += 1
+
+    def qedit(self, node_id: str, doc_id: str) -> None:
+        """Acknowledged-but-queued write: the char lands in the node's
+        admission queue and in the ghost acked ledger — only a flush
+        (or the handoff drain barrier) moves it into the oplog."""
+        ch = chr(ord("a") + self.edit_seq % 26)
+        self.edit_seq += 1
+        self.stores[node_id].queue_edit(doc_id, ch)
+        self.acked.setdefault(doc_id, []).append(ch)
+
+    def migrate(self, node_id: str, peer: str, doc_id: str) -> bool:
+        """The rebalancer's migration step: override first (rides the
+        grant), epoch-fenced handoff, tombstone on abort. A completed
+        move evicts the source's warm copy — with the drain barrier
+        intact the queue is empty by then; without it (the seeded
+        mutation) this is exactly where acked ops die."""
+        node = self.nodes[node_id]
+        ver = node.overrides.set(doc_id, peer)
+        ok = node.handoff(doc_id, peer, override_version=ver)
+        if ok:
+            self.stores[node_id].pending.pop(doc_id, None)
+        else:
+            node.overrides.clear(doc_id)
+        return ok
 
     def redeliver_last_lease_msg(self, node_id: str) -> None:
         req = self.last_lease_msg.get(node_id)

@@ -7,9 +7,14 @@ rules fire on the acquisition events.
 
 Canonical order (must only ever grow rightward while locks are held):
 
-  repl.maintain(0) -> repl.leases(2) -> repl.membership(3) ->
-  repl.peers(4) -> repl.quorum(5) -> global(10) -> shard(20) ->
-  io(25) -> oplog(30) -> device(40) -> leaf(50)
+  repl.maintain(0) -> repl.rebalance(1) -> repl.leases(2) ->
+  repl.membership(3) -> repl.peers(4) -> repl.quorum(5) ->
+  global(10) -> shard(20) -> io(25) -> oplog(30) -> device(40) ->
+  leaf(50)
+
+(`repl.rebalance` is the elastic-mesh planning rung: the rebalancer
+plans migrations under it and may then take lease state, but lease
+code must never call back into the planner — outer to repl.leases.)
 
 (`io` is the DocStore flush-pass serializer: it is deliberately OUTER
 to the oplog guard — encode runs under the store lock inside an
@@ -35,6 +40,7 @@ from ..lint import FileContext, Violation
 # lock has a strictly SMALLER level (same level: see rank/sorted rules)
 ORDER_LEVELS = {
     "repl.maintain": 0,
+    "repl.rebalance": 1,
     "repl.leases": 2,
     "repl.membership": 3,
     "repl.peers": 4,
@@ -83,6 +89,11 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
         return "oplog"
     if "_maintain_lock" in src:
         return "repl.maintain"
+    # elastic mesh: the rebalancer's planning guard and the placement
+    # override table both sit between maintain and the lease lock —
+    # migration planning reads lease state, never the reverse
+    if "_rebalance_lock" in src:
+        return "repl.rebalance"
     if src.endswith("leases.lock"):
         return "repl.leases"
     if "io_lock" in src:

@@ -11,12 +11,15 @@ Naming scheme:
   dt_serve_flush_reason_total{reason}
   dt_serve_shard_*{shard}             per-shard gauges/counters
   dt_repl_<group>_<key>_total         replication counters
+  dt_rebalance_<counter>_total /      elastic-mesh migrations (zero-
+  dt_rebalance_override_table_size    filled) + override-table gauge
   dt_read_<counter>_total             follower-read tier counters
   dt_read_local_ratio /               local-serve ratio gauge +
   dt_read_staleness_seconds           staleness histogram
   dt_<name>_latency_seconds           histograms (flush, handoff,
                                       quorum_round, probe,
-                                      antientropy_round)
+                                      antientropy_round,
+                                      rebalance_drain)
   dt_http_request_seconds{endpoint,method}
   dt_trace_* / dt_recorder_* / dt_devprof_*
   dt_slo_*{objective}                 burn-rate gauges + alert state
@@ -246,12 +249,28 @@ def _render_read(b: _Builder, read: dict) -> None:
 
 
 def _render_replication(b: _Builder, repl: dict) -> None:
+    # elastic mesh: dedicated dt_rebalance_* families, zero-filled (the
+    # snapshot always carries the group, so an idle mesh still exports
+    # every series). override_table_size is a point-in-time gauge; the
+    # rest are counters; the drain histogram rides the shared latency
+    # loop below as dt_rebalance_drain_latency_seconds.
+    rb = repl.get("rebalance")
+    if isinstance(rb, dict):
+        for k, v in sorted(rb.items()):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if k == "override_table_size":
+                b.add("dt_rebalance_override_table_size", "gauge", v)
+            else:
+                b.add(f"dt_rebalance_{k}_total", "counter", v)
     for group, vals in sorted(repl.items()):
         if group in ("version", "self", "latencies") or \
                 not isinstance(vals, dict):
             continue
         if group in ("per_peer", "membership_view", "quorum_view",
-                     "faults"):
+                     "faults", "rebalance"):
+            # rebalance rendered above under its own dt_rebalance_*
+            # prefix, not the generic dt_repl_* one
             continue
         for k, v in sorted(vals.items()):
             if isinstance(v, bool) or not isinstance(v, (int, float)):

@@ -27,6 +27,10 @@ from ..obs.hist import Histogram
 #                   catchup_satisfied, catchup_timeouts
 #   index feed:     adverts (owner frontier advertisements folded),
 #                   reconciles (completed anti-entropy reconciles noted)
+#   elastic mesh:   proxied_steered (staleness proxies redirected to a
+#                   lightly loaded follower instead of the owner),
+#                   warmed_on_hydrate (checkout-cache entries
+#                   pre-materialized when hydration finished)
 READ_KEYS = (
     "reads",
     "local",
@@ -47,6 +51,8 @@ READ_KEYS = (
     "catchup_timeouts",
     "adverts",
     "reconciles",
+    "proxied_steered",
+    "warmed_on_hydrate",
 )
 
 
@@ -59,7 +65,8 @@ class ReadMetrics:
     ``ReplicationMetrics._GROUPS``).
     """
 
-    SCHEMA_VERSION = 1
+    # v1 -> v2: elastic mesh — proxied_steered + warmed_on_hydrate
+    SCHEMA_VERSION = 2
 
     def __init__(self):
         self._lock = make_lock("read.metrics", "leaf")

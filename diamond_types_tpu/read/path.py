@@ -176,7 +176,11 @@ class ReadPath:
                           {SOURCE_HEADER: "refused"}, "refused")
 
     def _proxy(self, doc_id: str, owner: str, kind: str, reason: str,
-               min_version, trace=None) -> ReadResult:
+               min_version, trace=None,
+               soft_fail: bool = False) -> Optional[ReadResult]:
+        """``soft_fail`` (steered-follower attempts) returns None on any
+        failure instead of minting a 503 — the caller falls back to the
+        owner, so the read is not refused and must not count as one."""
         node = self.node
         span = self._span("read.proxy", trace, doc=doc_id, target=owner,
                           reason=reason)
@@ -192,15 +196,21 @@ class ReadPath:
                 timeout=self.proxy_timeout_s, headers=headers)
         except Exception as e:
             span.end(outcome="unreachable", error=e.__class__.__name__)
+            if soft_fail:
+                return None
             return self._refuse(f"{reason}; owner unreachable")
         if status != 200:
             span.end(outcome=f"status_{status}")
+            if soft_fail:
+                return None
             return self._refuse(f"{reason}; owner answered {status}")
         try:
             state = json.loads(body)
             text, remote = state["text"], state["version"]
         except (ValueError, KeyError, TypeError):
             span.end(outcome="bad_body")
+            if soft_fail:
+                return None
             return self._refuse(f"{reason}; bad owner response")
         span.end(outcome="ok")
         self.metrics.bump("proxied_min_version" if reason == "min_version"
@@ -213,6 +223,68 @@ class ReadPath:
         return ReadResult(200, text.encode("utf8"),
                           "text/plain; charset=utf-8", out_headers,
                           "proxied")
+
+    # ---- elastic-mesh hooks ----------------------------------------------
+
+    def warm_on_hydrate(self, doc_id: str, ol=None) -> bool:
+        """Hydrator completion hook: pre-materialize the checkout cache
+        entry for the doc's current frontier, so the first read after a
+        migration/hydration is a cache hit instead of a cold checkout.
+        ``ol`` is the freshly-installed oplog when the hydrator calls
+        this; store-resident docs pass None and resolve by id.
+        Best-effort — a doc evicted between hydrate and this call just
+        skips the warm."""
+        try:
+            with self.store.lock:
+                if ol is None:
+                    ol = self.store.docs.get(doc_id)
+                if ol is None:
+                    return False
+                frontier = list(ol.version)
+                remote = ol.cg.local_to_remote_frontier(frontier)
+            fkey = frontier_key(remote)
+
+            def materialize():
+                with self.store.lock:
+                    return ol.checkout(frontier).snapshot()
+
+            _text, outcome = self.cache.get(doc_id, fkey, materialize)
+        except Exception:       # pragma: no cover - warm must not wedge
+            return False
+        if outcome == "miss":   # freshly installed, not already warm
+            self.metrics.bump("warmed_on_hydrate")
+        return outcome in ("miss", "hit")
+
+    def _steer_target(self, doc_id: str, owner: str,
+                      max_staleness: Optional[float]):
+        """Pick a lightly loaded follower to absorb a staleness proxy
+        instead of the owner. Returns (peer_id, owner_advert_frontier)
+        or (None, None). Safety comes from the proxy protocol, not the
+        load table: we forward the owner's advertised frontier as the
+        min-version token, so the steered follower serves only if its
+        oplog provably contains it (and refuses otherwise — we then
+        fall back to the owner). The load numbers (gossiped held-lease
+        counts) only decide WHO to try."""
+        node = self.node
+        advert = self.index.advert_of(doc_id, owner)
+        if advert is None:
+            return None, None
+        frontier, as_of = advert
+        age = max(0.0, time.monotonic() - as_of)
+        if max_staleness is not None and age > max_staleness:
+            return None, None   # evidence too old to promise anything
+        loads = getattr(node, "peer_load", None)
+        if not loads:
+            return None, None
+        owner_load = loads.get(owner)
+        if owner_load is None:
+            return None, None
+        cands = [(load, pid) for pid, load in loads.items()
+                 if pid not in (owner, node.self_id)
+                 and pid in node.ownership_ids() and load < owner_load]
+        if not cands:
+            return None, None
+        return min(cands)[1], frontier
 
     # ---- the decision ----------------------------------------------------
 
@@ -274,6 +346,20 @@ class ReadPath:
             if staleness is None or staleness > max_staleness:
                 if owner == node.self_id:
                     return self._refuse("staleness; no reachable owner")
+                # elastic mesh: try a lightly loaded follower first,
+                # proving freshness via the min-version token (the
+                # owner's advertised frontier, merged with the
+                # client's own token); any failure falls back to the
+                # owner proxy
+                target, adv = self._steer_target(doc_id, owner,
+                                                 max_staleness)
+                if target is not None:
+                    token = list(adv) + list(min_version or [])
+                    res = self._proxy(doc_id, target, kind, "staleness",
+                                      token, trace, soft_fail=True)
+                    if res is not None:
+                        self.metrics.bump("proxied_steered")
+                        return res
                 return self._proxy(doc_id, owner, kind, "staleness",
                                    min_version, trace)
             return self._serve_local(doc_id, ol, kind, staleness)
@@ -296,6 +382,11 @@ def attach_follower_reads(store, **opts) -> ReadPath:
         sched.read_invalidate = rp.on_flush
         if getattr(sched, "metrics", None) is not None:
             sched.metrics.read = rp.metrics
+        # elastic mesh: pre-materialize the checkout cache whenever the
+        # residency tier brings a doc warm (first read after a
+        # migration/hydration hits instead of checking out cold)
+        if getattr(sched, "hydrator", None) is not None:
+            sched.hydrator.on_warm = rp.warm_on_hydrate
     # live-telemetry double-write: read counters/staleness/waits land
     # in the windowed TimeSeries for the read-staleness SLO
     obs = getattr(store, "obs", None)

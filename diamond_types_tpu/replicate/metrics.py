@@ -6,6 +6,12 @@ snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
 Changelog:
+  v5  elastic-mesh rebalancer: new `rebalance` group (overrides
+      set/cleared/merged, migrations started/completed/aborted, and
+      `override_table_size` injected by the node at snapshot time),
+      `antientropy.adverts_relayed` (follower→follower frontier advert
+      relay), and a seeded `rebalance_drain` latency histogram (the
+      drain phase of a live migration).
   v4  `antientropy.frontier_adverts` — owner frontier advertisements
       folded into the follower-read tier's FollowerIndex (from ping
       gossip and `/replicate/docs` piggybacks; read/follower.py).
@@ -21,7 +27,7 @@ Changelog:
 
 Schema (snapshot()):
 
-  {"version": 4, "self": "host:port",
+  {"version": 5, "self": "host:port",
    "leases": {"held", "acquires", "renewals", "takeovers", "releases",
               "tie_breaks",        # equal-epoch conflicts arbitrated
               "churn"},            # churn = acquires+takeovers+releases
@@ -29,7 +35,11 @@ Schema (snapshot()):
                 "latency_s_total", "latency_s_max"},
    "antientropy": {"rounds", "docs_checked", "docs_pulled",
                    "docs_pushed", "bytes_pulled", "bytes_pushed",
-                   "errors", "frontier_adverts"},
+                   "errors", "frontier_adverts", "adverts_relayed"},
+   "rebalance": {"overrides_set", "overrides_cleared",
+                 "override_merges", "migrations_started",
+                 "migrations_completed", "migrations_aborted",
+                 "override_table_size"},  # size injected at snapshot
    "proxy": {"proxied", "fallback_local", "loops_refused",
              "fenced_relays"},     # 409-fenced proxies retried locally
    "merge_gate": {"admits", "denials"},
@@ -43,7 +53,8 @@ Schema (snapshot()):
    "membership": {"joins", "leaves", "suspicions", "refutations",
                   "deaths"},
    "latencies": {"handoff": hist, "quorum_round": hist,
-                 "probe": hist, "antientropy_round": hist},
+                 "probe": hist, "antientropy_round": hist,
+                 "rebalance_drain": hist},
    "per_peer": {peer_id: {"consecutive_failures", "circuit_open",
                           "backoff_s", "last_ok_age_s"}},
    "membership_view": {"view_version", "members": {...}} | null,
@@ -59,7 +70,7 @@ from typing import Dict
 from ..obs.hist import Histogram
 
 _LATENCY_NAMES = ("handoff", "quorum_round", "probe",
-                  "antientropy_round")
+                  "antientropy_round", "rebalance_drain")
 
 _GROUPS = {
     "leases": ("acquires", "renewals", "takeovers", "releases",
@@ -67,7 +78,10 @@ _GROUPS = {
     "handoffs": ("started", "completed", "failed"),
     "antientropy": ("rounds", "docs_checked", "docs_pulled",
                     "docs_pushed", "bytes_pulled", "bytes_pushed",
-                    "errors", "frontier_adverts"),
+                    "errors", "frontier_adverts", "adverts_relayed"),
+    "rebalance": ("overrides_set", "overrides_cleared",
+                  "override_merges", "migrations_started",
+                  "migrations_completed", "migrations_aborted"),
     "proxy": ("proxied", "fallback_local", "loops_refused",
               "fenced_relays"),
     "merge_gate": ("admits", "denials"),
@@ -83,8 +97,9 @@ _GROUPS = {
 
 
 class ReplicationMetrics:
-    # v3 -> v4: antientropy.frontier_adverts (see changelog)
-    SCHEMA_VERSION = 4
+    # v4 -> v5: rebalance group + adverts_relayed + rebalance_drain
+    # histogram (see changelog)
+    SCHEMA_VERSION = 5
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id
@@ -121,7 +136,8 @@ class ReplicationMetrics:
 
     def snapshot(self, leases_held: int = 0, per_peer: dict = None,
                  faults: dict = None, membership_view: dict = None,
-                 quorum_view: dict = None) -> dict:
+                 quorum_view: dict = None,
+                 override_table_size: int = 0) -> dict:
         # histograms carry their own locks; snapshot before taking ours
         latencies = {n: h.snapshot() for n, h in
                      sorted(self.hist.items())}
@@ -135,12 +151,15 @@ class ReplicationMetrics:
             # v2-compat keys, now derived from the histogram
             handoffs["latency_s_total"] = handoff["sum"]
             handoffs["latency_s_max"] = handoff["max"]
+            rebalance = dict(self._c["rebalance"])
+            rebalance["override_table_size"] = int(override_table_size)
             return {
                 "version": self.SCHEMA_VERSION,
                 "self": self.self_id,
                 "leases": leases,
                 "handoffs": handoffs,
                 "antientropy": dict(self._c["antientropy"]),
+                "rebalance": rebalance,
                 "proxy": dict(self._c["proxy"]),
                 "merge_gate": dict(self._c["merge_gate"]),
                 "probes": dict(self._c["probes"]),

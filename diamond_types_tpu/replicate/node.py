@@ -66,6 +66,7 @@ from .metrics import ReplicationMetrics
 from .ownership import DRAINING, TRANSFER, LeaseManager, owner_of
 from .peers import PeerTable
 from .quorum import QuorumCoordinator, ReplicaJournal
+from .rebalance import PlacementOverrides
 
 MUTATION_ACTIONS = ("push", "edit", "ops")
 
@@ -148,6 +149,26 @@ class ReplicaNode:
         self.quorum = QuorumCoordinator(self)
         self.leases.quorum = self._run_quorum
         self.table.on_ping = self._on_ping
+        # elastic-mesh tier (replicate/rebalance.py): the placement-
+        # override table layered over rendezvous hashing. Restored from
+        # the journal, gossiped on pings, consulted by desired_owner —
+        # so routing, the merge-admission gate and the maintain loop
+        # all follow an override the moment it lands.
+        self.overrides = PlacementOverrides(journal=self.journal,
+                                            metrics=self.metrics)
+        # gossiped held-lease counts (ping "load" field): the
+        # rebalancer's target-selection signal. A just-joined host has
+        # no entry and reads as load 0 — the preferred target.
+        self.peer_load = {}
+        # attach_rebalancer hangs the SLO-driven control loop here; the
+        # probe loop ticks it after maintain()
+        self.rebalancer = None
+        # follower->follower frontier advert relay: doc -> (origin,
+        # frontier, hops, heard_at). Entries at hops <= max_relay_hops
+        # ride our ping bodies so a follower two hops from the owner
+        # still gets staleness evidence without an owner link.
+        self._relay_adverts = {}
+        self.max_relay_hops = 1
         self.antientropy = AntiEntropy(
             self, interval_s=antientropy_interval_s)
         self.probe_interval_s = probe_interval_s
@@ -193,7 +214,15 @@ class ReplicaNode:
         return self.membership.universe()
 
     def desired_owner(self, doc_id: str) -> str:
-        return owner_of(doc_id, self.ownership_ids())
+        """Placement: the override table wins when its target is still
+        in the ownership universe; rendezvous hashing otherwise. An
+        override pointing at a DEAD/LEFT host is simply ignored, so a
+        failed migration target never strands a doc."""
+        ids = self.ownership_ids()
+        override = self.overrides.target_of(doc_id)
+        if override is not None and override in ids:
+            return override
+        return owner_of(doc_id, ids)
 
     def owns(self, doc_id: str) -> bool:
         """The scheduler's merge-admission gate: True iff this host
@@ -293,12 +322,15 @@ class ReplicaNode:
 
     # ---- handoff (sender) ------------------------------------------------
 
-    def handoff(self, doc_id: str, new_owner: str) -> bool:
+    def handoff(self, doc_id: str, new_owner: str,
+                override_version: Optional[int] = None) -> bool:
         """Move doc ownership to `new_owner` without ever having two
         active mergers: grant → drain → final patch → activate (the
         receiver's activate runs the quorum round for the new epoch).
         Any failure aborts back to ACTIVE (the remote GRANTED lease
-        simply expires)."""
+        simply expires). `override_version` (rebalancer migrations)
+        ships the placement-override entry ON the grant message, so the
+        receiver keeps the doc instead of rendezvous handing it back."""
         t0 = time.monotonic()
         new_epoch = self.leases.begin_handoff(doc_id)
         if new_epoch is None:
@@ -324,21 +356,26 @@ class ReplicaNode:
             # TTL covers the whole handoff, so a crashed sender leaves
             # a lease that expires rather than a stuck doc)
             with phase("repl.handoff.grant"):
+                grant = {"action": "grant", "doc": doc_id,
+                         "epoch": new_epoch,
+                         "ttl_s": self.leases.ttl_s * 4}
+                if override_version is not None:
+                    grant["override"] = [doc_id, new_owner,
+                                         int(override_version)]
                 resp = self.table.call_json(
-                    new_owner, "/replicate/lease",
-                    {"action": "grant", "doc": doc_id,
-                     "epoch": new_epoch,
-                     "ttl_s": self.leases.ttl_s * 4},
-                    headers=hdrs)
+                    new_owner, "/replicate/lease", grant, headers=hdrs)
                 if not resp.get("ok"):
                     raise ValueError(f"grant refused: {resp!r}")
             # drain: flush our pending merge work for the doc so the
             # final patch includes every admitted op
             with phase("repl.handoff.drain"):
+                td = time.monotonic()
                 self.leases.advance_handoff(doc_id, DRAINING)
                 sched = getattr(self.store, "scheduler", None)
                 if sched is not None:
                     sched.drain()
+                self.metrics.observe_latency("rebalance_drain",
+                                             time.monotonic() - td)
             # final patch transfer (from the receiver's common version)
             with phase("repl.handoff.transfer"):
                 self.leases.advance_handoff(doc_id, TRANSFER)
@@ -400,6 +437,11 @@ class ReplicaNode:
         if action == "grant":
             ok = self.leases.accept_grant(
                 doc_id, epoch, float(req.get("ttl_s", 0.0)))
+            if ok and req.get("override") is not None:
+                # rebalancer migration rider: install the placement
+                # override atomically with the grant, so our own
+                # maintain loop keeps the doc once it activates
+                self.overrides.merge([req["override"]])
             return {"ok": ok}
         if action == "activate":
             # the handoff's quorum round: the new epoch must win a
@@ -407,6 +449,8 @@ class ReplicaNode:
             if not self._run_quorum(doc_id, epoch, False):
                 return {"ok": False, "error": "quorum"}
             ok = self.leases.activate_grant(doc_id, epoch)
+            if ok:
+                self._pin_migrated_doc(doc_id)
             return {"ok": ok}
         if action == "status":
             lease = self.leases.get(doc_id)
@@ -416,6 +460,24 @@ class ReplicaNode:
                     "max_epoch": self.leases.max_epoch_of(doc_id),
                     "rejoining": self.rejoining}
         return {"ok": False, "error": f"bad action {action!r}"}
+
+    def _pin_migrated_doc(self, doc_id: str) -> None:
+        """On activating a migrated doc, steer it onto this host's
+        least-loaded shard (ShardRouter.pin). Rendezvous shard routing
+        knows nothing about load, and a doc hot enough to migrate is
+        hot enough to deserve the emptiest chip. Best-effort: no
+        scheduler/router (raw stores, sims) means no pin."""
+        if self.overrides.target_of(doc_id) != self.self_id:
+            return
+        sched = getattr(self.store, "scheduler", None)
+        router = getattr(sched, "router", None)
+        if router is None or router.n_shards < 2:
+            return
+        try:
+            counts = router.counts()
+            router.pin(doc_id, counts.index(min(counts)))
+        except (ValueError, AttributeError):  # pragma: no cover
+            pass
 
     # ---- membership wire handlers ----------------------------------------
 
@@ -427,10 +489,20 @@ class ReplicaNode:
                "incarnation": self.membership.self_incarnation,
                "view_version": self.membership.view_version,
                "rejoining": self.rejoining,
+               # held-lease count: the rebalancer's load signal
+               "load": self.leases.held_count(),
                "members": self.membership.gossip_payload()}
+        overrides = self.overrides.gossip_payload()
+        if overrides:
+            out["overrides"] = overrides
         frontiers = self._owned_frontiers()
         if frontiers is not None:
             out["frontiers"] = frontiers
+            relayed = self._relayed_frontiers()
+            if relayed:
+                out["relayed_frontiers"] = relayed
+                self.metrics.bump("antientropy", "adverts_relayed",
+                                  len(relayed))
         return out
 
     def _owned_frontiers(self, cap: int = 32):
@@ -453,6 +525,25 @@ class ReplicaNode:
                         ol.cg.local_to_remote_frontier(ol.version)
         return frontiers
 
+    def _relayed_frontiers(self, cap: int = 32):
+        """Follower->follower advert relay: re-advertise frontiers we
+        heard DIRECTLY from their owners (hops <= max_relay_hops), so a
+        follower without an owner link still accumulates staleness
+        evidence. Entries age out after a few probe intervals — a
+        relay must never outlive the evidence it carries."""
+        now = self.clock()
+        ttl = max(self.probe_interval_s * 6, 3.0)
+        stale = [d for d, (_o, _f, _h, at) in
+                 self._relay_adverts.items() if now - at > ttl]
+        for d in stale:
+            self._relay_adverts.pop(d, None)
+        out = {}
+        for doc_id, (origin, frontier, hops, _at) in \
+                sorted(self._relay_adverts.items())[:cap]:
+            if hops <= self.max_relay_hops:
+                out[doc_id] = [origin, frontier, hops]
+        return out
+
     def _on_ping(self, peer_id: str, body: dict) -> None:
         """Probe-loop gossip hook: fold the responder's member table,
         and open transport to any member we just learned about."""
@@ -463,6 +554,14 @@ class ReplicaNode:
                 if isinstance(info, dict) \
                         and info.get("state") != LEFT:
                     self.table.add_peer(mid)
+        # rebalancer gossip: the responder's held-lease count (target
+        # selection) and its placement-override table (LWW merge)
+        load = body.get("load")
+        if isinstance(load, int):
+            self.peer_load[peer_id] = load
+        overrides = body.get("overrides")
+        if overrides:
+            self.overrides.merge(overrides)
         # frontier advertisements for the follower-read tier: the
         # responder gossips the frontiers of docs it holds ACTIVE
         # leases on. Fold time stands in for send time (sub-RTT slop;
@@ -470,12 +569,31 @@ class ReplicaNode:
         frontiers = body.get("frontiers")
         reads = getattr(self.store, "reads", None)
         if reads is not None and isinstance(frontiers, dict):
+            now = self.clock()
             for doc_id, frontier in frontiers.items():
                 if frontier:
                     reads.index.note_advert(doc_id, peer_id, frontier)
+                    # owner-direct advert: candidate for one relay hop
+                    self._relay_adverts[doc_id] = (peer_id, frontier,
+                                                   1, now)
             if frontiers:
                 self.metrics.bump("antientropy", "frontier_adverts",
                                   len(frontiers))
+        # relayed adverts: credit the ORIGIN owner, with the advert
+        # aged by the relay hops (one probe interval per hop) so the
+        # staleness contract stays conservative
+        relayed = body.get("relayed_frontiers")
+        if reads is not None and isinstance(relayed, dict):
+            for doc_id, row in relayed.items():
+                if not (isinstance(row, list) and len(row) == 3):
+                    continue
+                origin, frontier, hops = row
+                if origin == self.self_id or not frontier:
+                    continue
+                age = self.probe_interval_s * max(int(hops), 1)
+                reads.index.note_advert(
+                    doc_id, origin, frontier,
+                    as_of=time.monotonic() - age)
 
     def handle_join(self, req: dict) -> dict:
         """`POST /replicate/join` — a node announces itself (bootstrap
@@ -600,7 +718,8 @@ class ReplicaNode:
             membership_view=self.membership.as_json(),
             quorum_view={"voters": self.membership.voters(),
                          "quorum": self.membership.quorum_size(),
-                         "rejoining": self.rejoining})
+                         "rejoining": self.rejoining},
+            override_table_size=self.overrides.size())
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -615,6 +734,9 @@ class ReplicaNode:
                 try:
                     self.table.probe_once()
                     self.maintain()
+                    rb = self.rebalancer
+                    if rb is not None:
+                        rb.tick()
                 except Exception:   # pragma: no cover - keep running
                     pass
 

@@ -66,6 +66,8 @@ class ReplicaJournal:
       {"t": "lease", "doc": str, "holder": str, "epoch": int,
        "state": str}                                 # held-lease hint
       {"t": "drop_lease", "doc": str}
+      {"t": "override", "doc": str, "target": str | null, "ver": int}
+                                    # placement override (null = tombstone)
 
     Promises are persisted because they are the safety core: a voter
     that promised (doc, E) to A, crashed, and forgot could promise
@@ -136,6 +138,15 @@ class ReplicaJournal:
                 "state": rec.get("state", "active")}
         elif t == "drop_lease":
             self.state.setdefault("leases", {}).pop(rec["doc"], None)
+        elif t == "override":
+            # last-writer-wins by version, matching
+            # rebalance.PlacementOverrides.merge (tombstones kept — a
+            # restored table must remember retractions too)
+            ov = self.state.setdefault("overrides", {})
+            cur = ov.get(rec["doc"])
+            if cur is None or int(rec["ver"]) >= int(cur.get("ver", 0)):
+                ov[rec["doc"]] = {"target": rec.get("target"),
+                                  "ver": int(rec["ver"])}
 
     def record(self, rec: dict, sync: bool = False) -> None:
         with self._lock:
@@ -181,6 +192,10 @@ class ReplicaJournal:
     def drop_lease(self, doc: str) -> None:
         self.record({"t": "drop_lease", "doc": doc})
 
+    def note_override(self, doc: str, target, ver: int) -> None:
+        self.record({"t": "override", "doc": doc, "target": target,
+                     "ver": int(ver)})
+
     # ---- restored views --------------------------------------------------
 
     def restored_incarnation(self) -> int:
@@ -196,11 +211,15 @@ class ReplicaJournal:
     def restored_leases(self) -> Dict[str, dict]:
         return dict(self.state.get("leases", {}))
 
+    def restored_overrides(self) -> Dict[str, dict]:
+        return dict(self.state.get("overrides", {}))
+
     def has_prior_state(self) -> bool:
         return bool(self.state.get("incarnation", 0)
                     or self.state.get("max_epoch")
                     or self.state.get("leases")
-                    or self.state.get("promises"))
+                    or self.state.get("promises")
+                    or self.state.get("overrides"))
 
     def close(self) -> None:
         with self._lock:

@@ -1,0 +1,412 @@
+"""Flash-crowd elastic-mesh soak (CLI: `rebalance-soak`).
+
+Boots N in-process sync servers (one serve shard each, follower reads
+on) into one replication mesh, lets rendezvous placement spread the
+docs, then runs a deterministic closed-loop load model against a
+tight custom SLO:
+
+  * healthy phase — per-edit RTT observations land under the latency
+    threshold on every owner; the `soak_edit_rtt` objective reads
+    `ok` everywhere;
+  * flash crowd — one doc goes hot and its owner's capacity saturates
+    (modeled as a fixed load boost on top of that host's held-lease
+    count); every edit owned by the crowded host observes an
+    over-threshold RTT, its objective burns, and the REBALANCER —
+    ticked from the same single-threaded control-plane step as probes
+    and anti-entropy, no operator in the loop — sheds the hot doc
+    first (attribution-ranked) and keeps shedding until the host fits
+    its capacity again;
+  * scale-out — on the first non-`ok` evaluation a fresh host joins
+    the mesh via /replicate/join; with gossiped load 0 it is the
+    least-loaded target and must absorb at least one migrated doc;
+  * self-healing — one migration is aimed at an unreachable target on
+    purpose: the handoff must abort back to ACTIVE at the source with
+    the SAME epoch and the placement override tombstoned (a failed
+    target never strands a doc);
+  * recovery — with the crowd still running, the migrated layout keeps
+    every host under capacity, good observations dilute / age out the
+    burn windows, and the objective returns to `ok`.
+
+Exit-0 verdict (the `--flash-crowd` acceptance gate): the SLO journey
+ok -> burning -> ok completed without operator action, at least one
+migration ran, the joined host absorbed load, the seeded abort rolled
+back cleanly, every server converged byte-identically on every doc,
+and the activation-history scan found zero split-brain.
+
+Like the other soaks, the replication control plane is stepped inline
+and single-threaded so a given seed replays exactly; only the HTTP
+servers run real threads.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..obs import Objective
+from .node import attach_replication
+from .rebalance import attach_rebalancer
+from .soak import _converged, _final_texts, _split_brain
+
+# per-event latency budget of the soak objective; the load model emits
+# 0.01 s (healthy) or 1.0 s (saturated) observations around it
+_RTT_THRESHOLD_S = 0.5
+_RTT_GOOD_S = 0.01
+_RTT_BAD_S = 1.0
+# observation weights: the hot doc is hammered, the crowded host's
+# other docs feel the contention, everything else idles along
+_W_HOT = 12
+_W_CROWDED = 3
+
+
+def _objective(fast_window_s: float, slow_window_s: float) -> Objective:
+    # target 0.7 => warning at bad-fraction 0.3, burning at 0.6 on
+    # both windows — tight enough that one saturated round pages,
+    # short enough that recovery is observable in soak wall time
+    return Objective("soak_edit_rtt", "soak.edit_rtt",
+                     threshold_s=_RTT_THRESHOLD_S, target=0.7,
+                     fast_window_s=fast_window_s,
+                     slow_window_s=slow_window_s,
+                     fast_burn=2.0, slow_burn=2.0)
+
+
+def run_rebalance_soak(servers: int = 3, docs: int = 8, seed: int = 7,
+                       capacity: int = 5, crowd_boost: int = 3,
+                       healthy_rounds: int = 3,
+                       crowd_rounds: int = 6,
+                       recover_rounds: int = 60,
+                       reconcile_rounds: int = 20,
+                       flash_crowd: bool = True,
+                       join: bool = True,
+                       inject_abort: bool = True,
+                       lease_ttl_s: float = 30.0,
+                       fast_window_s: float = 3.0,
+                       slow_window_s: float = 6.0,
+                       progress: bool = False) -> dict:
+    from ..tools.server import SyncClient, serve
+
+    rng = random.Random(seed)
+    doc_ids = [f"elastic-{i}" for i in range(docs)]
+    obs_opts = dict(sample_rate=0.0, ts_window_s=0.5, ts_windows=64,
+                    objectives=[_objective(fast_window_s,
+                                           slow_window_s)])
+    node_opts = dict(seed=seed, lease_ttl_s=lease_ttl_s,
+                     probe_interval_s=0.25,
+                     antientropy_interval_s=0.25,
+                     timeout_s=2.0, backoff_base_s=0.02,
+                     backoff_cap_s=0.1)
+    # act only on burning: the gate's SLO journey must REACH burning
+    # before the first migration cures the crowd — acting on warning
+    # too (the default) would race the journey against the fix under
+    # wall-clock contention
+    rb_opts = dict(cooldown_s=0.2, max_migrations_per_tick=1,
+                   min_load_gap=2, top_n=4, act_on=("burning",))
+
+    httpds: List = []
+    nodes: List = []
+    addrs: List[str] = []
+
+    def boot(join_to: Optional[str] = None):
+        httpd = serve(port=0, serve_shards=1, follower_reads=True,
+                      obs_opts=dict(obs_opts))
+        httpd.socket.listen(128)
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        node = attach_replication(httpd, addr, [], **node_opts)
+        attach_rebalancer(node, **rb_opts)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        if join_to is not None:
+            node.join_mesh(join_to)
+        return httpd, node, addr
+
+    for i in range(servers):
+        httpd = serve(port=0, serve_shards=1, follower_reads=True,
+                      obs_opts=dict(obs_opts))
+        httpd.socket.listen(128)
+        httpds.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    for i, httpd in enumerate(httpds):
+        node = attach_replication(
+            httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+            **node_opts)
+        attach_rebalancer(node, **rb_opts)
+        nodes.append(node)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+    migrations: List[List[str]] = []
+    tick_aborts: List[List[str]] = []
+
+    def step_control_plane() -> None:
+        for n in nodes:
+            n.table.probe_once()
+            n.maintain()
+        for n in nodes:
+            rep = n.rebalancer.tick()
+            migrations.extend(rep["migrated"])
+            tick_aborts.extend(rep["aborted"])
+        for n in nodes:
+            n.antientropy.run_round()
+
+    clients: Dict[tuple, SyncClient] = {}
+
+    def client(i: int, doc_id: str) -> SyncClient:
+        key = (i, doc_id)
+        if key not in clients:
+            clients[key] = SyncClient(
+                f"http://{addrs[i]}", doc_id,
+                f"agent-{i}-{doc_id}", retries=2)
+        return clients[key]
+
+    def edit(i: int, doc_id: str, word: str) -> bool:
+        c = client(i, doc_id)
+        try:
+            c.pull()
+        except OSError:
+            pass
+        c.insert(rng.randrange(len(c.text()) + 1), word + " ")
+        try:
+            c.sync()
+            return True
+        except OSError:
+            return False
+
+    def owner_of(doc_id: str):
+        holders = [n for n in nodes
+                   if n.leases.active_epoch(doc_id) > 0]
+        return holders[0] if len(holders) == 1 else None
+
+    crowd_target = None     # the host the SLO journey is tracked on
+    hot_doc = doc_ids[0]    # re-picked after settle (most-loaded host)
+
+    def observe_round(crowd_on: bool) -> None:
+        """The load model: weighted RTT observations per doc at its
+        owner. The crowd load FOLLOWS the hot doc — whichever host
+        currently owns it carries the boost on top of its held-lease
+        count, so migrating the hot doc to a host with headroom (and
+        only that) is what restores the SLO."""
+        hot_owner = owner_of(hot_doc) if crowd_on else None
+        for doc_id in doc_ids:
+            own = owner_of(doc_id)
+            if own is None:
+                continue
+            eff = own.leases.held_count() \
+                + (crowd_boost if own is hot_owner else 0)
+            rtt = _RTT_BAD_S if eff > capacity else _RTT_GOOD_S
+            if not crowd_on:
+                weight = 1
+            elif doc_id == hot_doc:
+                weight = _W_HOT
+            elif own is hot_owner:
+                weight = _W_CROWDED
+            else:
+                weight = 1
+            for _ in range(weight):
+                own.obs.ts.observe("soak.edit_rtt", rtt)
+            own.obs.attrib.note("ops", doc=doc_id, n=float(weight))
+
+    def slo_state() -> str:
+        if crowd_target is None:
+            return "ok"
+        return crowd_target.obs.slo.evaluate()[0]["state"]
+
+    t0 = time.monotonic()
+    edits = 0
+
+    # ---- seed + settle: one ACTIVE owner per doc --------------------------
+    for doc_id in doc_ids:
+        if edit(rng.randrange(servers), doc_id, "seed"):
+            edits += 1
+    for _ in range(40):
+        step_control_plane()
+        if all(owner_of(d) is not None for d in doc_ids):
+            break
+        time.sleep(0.02)
+    settled = all(owner_of(d) is not None for d in doc_ids)
+    held_initial = {n.self_id: n.leases.held_count() for n in nodes}
+    # the hot doc lives on the most-loaded host: with boost just under
+    # capacity, saturation needs co-resident load, and the crowded
+    # host only recovers by SHEDDING (a one-doc host never saturates)
+    crowd_target = max(nodes, key=lambda n: n.leases.held_count())
+    held = crowd_target.leases.held_ids()
+    if held:
+        hot_doc = held[0]
+
+    states: List[str] = []
+
+    # ---- healthy phase ----------------------------------------------------
+    for _ in range(healthy_rounds):
+        if edit(rng.randrange(servers), rng.choice(doc_ids), "calm"):
+            edits += 1
+        observe_round(crowd_on=False)
+        step_control_plane()
+        states.append(slo_state())
+        time.sleep(0.02)
+    healthy_state = states[-1] if states else "ok"
+
+    joined_addr: Optional[str] = None
+    joined_node = None
+    burn_seen = False
+
+    # ---- flash crowd ------------------------------------------------------
+    if flash_crowd and crowd_target is not None:
+        # adaptive: at least crowd_rounds, and keep crowding until the
+        # SLO actually reaches burning (capped) — window rollover
+        # timing under a loaded machine must not decide the journey
+        max_crowd = max(crowd_rounds, 40)
+        r = -1
+        while (r := r + 1) < crowd_rounds \
+                or (not burn_seen and r < max_crowd):
+            for _ in range(2):
+                if edit(rng.randrange(len(addrs)), hot_doc, "crowd"):
+                    edits += 1
+            if edit(rng.randrange(len(addrs)),
+                    rng.choice(doc_ids), "bg"):
+                edits += 1
+            observe_round(crowd_on=True)
+            st = slo_state()
+            states.append(st)
+            burn_seen = burn_seen or st == "burning"
+            # scale-out response: the join lands BEFORE this round's
+            # rebalancer tick, so the fresh (load 0) host is already
+            # the preferred target when migrations are planned
+            if st != "ok" and join and joined_node is None:
+                httpd, joined_node, joined_addr = boot(
+                    join_to=addrs[0])
+                httpds.append(httpd)
+                nodes.append(joined_node)
+                addrs.append(joined_addr)
+                if progress:
+                    print(f"crowd round {r + 1}: slo={st}; "
+                          f"joined {joined_addr}")
+            step_control_plane()
+            if progress:
+                print(f"crowd round {r + 1}: slo={st} target.held="
+                      f"{crowd_target.leases.held_count()} "
+                      f"migrations={len(migrations)}")
+            time.sleep(0.05)
+
+        # ---- recovery: the crowd keeps running ----------------------------
+        for r in range(recover_rounds):
+            if edit(rng.randrange(len(addrs)), hot_doc, "crowd"):
+                edits += 1
+            observe_round(crowd_on=True)
+            step_control_plane()
+            st = slo_state()
+            states.append(st)
+            if st == "ok":
+                break
+            time.sleep(0.25)
+
+    # ---- seeded abort: migration at an unreachable target -----------------
+    abort_rollback_ok = None
+    if inject_abort:
+        victims = [n for n in nodes if n.leases.held_count() > 0]
+        src = victims[0] if victims else nodes[0]
+        doc_id = src.leases.held_ids()[0]
+        epoch_before = src.leases.active_epoch(doc_id)
+        aborted_before = src.metrics.get("rebalance",
+                                         "migrations_aborted")
+        moved = src.rebalancer.migrate(doc_id, "127.0.0.1:1")
+        abort_rollback_ok = (
+            not moved
+            and src.leases.active_epoch(doc_id) == epoch_before
+            and epoch_before > 0
+            and src.overrides.target_of(doc_id) is None
+            and src.metrics.get("rebalance", "migrations_aborted")
+            == aborted_before + 1)
+
+    # ---- reconcile to convergence -----------------------------------------
+    converged_after = None
+    for r in range(reconcile_rounds):
+        step_control_plane()
+        if _converged(addrs, doc_ids):
+            converged_after = r + 1
+            break
+        time.sleep(0.05)
+    texts = _final_texts(addrs, doc_ids)
+    converged = all(len(set(v.values())) == 1 for v in texts.values())
+    split_brain = _split_brain(nodes)
+
+    slo_journey_ok = (not flash_crowd) or (
+        healthy_state == "ok" and burn_seen
+        and bool(states) and states[-1] == "ok")
+    join_absorbed = (not (flash_crowd and join)) or (
+        joined_node is not None
+        and (joined_node.leases.held_count() > 0
+             or any(n.overrides.target_of(d) == joined_addr
+                    for n in nodes for d in doc_ids)))
+    ok = bool(
+        settled and converged and not split_brain
+        and slo_journey_ok and join_absorbed
+        and (not flash_crowd or len(migrations) >= 1)
+        and (abort_rollback_ok is None or abort_rollback_ok))
+
+    report = {
+        "config": {"servers": servers, "docs": docs, "seed": seed,
+                   "capacity": capacity, "crowd_boost": crowd_boost,
+                   "flash_crowd": flash_crowd, "join": join,
+                   "inject_abort": inject_abort,
+                   "lease_ttl_s": lease_ttl_s},
+        "edits_applied": edits,
+        "settled": settled,
+        "held_initial": held_initial,
+        "crowd_target": getattr(crowd_target, "self_id", None),
+        "hot_doc": hot_doc,
+        "slo_states": states,
+        "slo_journey_ok": slo_journey_ok,
+        "burning_seen": burn_seen,
+        "migrations": migrations,
+        "tick_aborts": tick_aborts,
+        "joined": joined_addr,
+        "join_absorbed": join_absorbed,
+        "abort_rollback_ok": abort_rollback_ok,
+        "held_final": {n.self_id: n.leases.held_count()
+                       for n in nodes},
+        "override_tables": {n.self_id: n.overrides.size()
+                            for n in nodes},
+        "converged": converged,
+        "converged_after_reconcile_rounds": converged_after,
+        "split_brain": split_brain,
+        "zero_split_brain": not split_brain,
+        "wall_s": round(time.monotonic() - t0, 3),
+        "metrics": {n.self_id: n.metrics_json() for n in nodes},
+        "ok": ok,
+    }
+    if not ok:
+        events = []
+        for n in nodes:
+            obs = getattr(n, "obs", None)
+            if obs is None:
+                continue
+            for ev in obs.recorder.tail(50):
+                events.append(dict(ev, node=n.self_id))
+        events.sort(key=lambda e: e.get("t", 0.0))
+        report["events_tail"] = events[-50:]
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    return report
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised via cli.py
+    import argparse
+    p = argparse.ArgumentParser(prog="rebalance-soak")
+    p.add_argument("--servers", type=int, default=3)
+    p.add_argument("--docs", type=int, default=8)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--flash-crowd", action="store_true")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    report = run_rebalance_soak(servers=args.servers, docs=args.docs,
+                                seed=args.seed,
+                                flash_crowd=args.flash_crowd)
+    print(json.dumps(report if args.json else {
+        k: report[k] for k in ("ok", "slo_journey_ok", "burning_seen",
+                               "migrations", "join_absorbed",
+                               "abort_rollback_ok", "converged",
+                               "zero_split_brain", "wall_s")}))
+    return 0 if report["ok"] else 1

@@ -86,6 +86,10 @@ class Hydrator:
         self.recorder = recorder    # obs FlightRecorder, may be None
         self.attrib = None          # obs HotAttribution (attach_obs):
                                     # per-doc cache-miss attribution
+        # elastic mesh: called as on_warm(doc_id, ol) after a hydration
+        # installs (read.attach_follower_reads wires the checkout-cache
+        # pre-materializer here). Invoked with NO hydrator locks held.
+        self.on_warm = None
         self.backoff = backoff if backoff is not None else Backoff(
             base_s=0.002, cap_s=0.05, seed=seed, key="hydrate")
         self._hydrate_lock = make_lock("hydrate.warm", "io")
@@ -277,6 +281,12 @@ class Hydrator:
         with self._warm_cv:
             self._warm_cv.notify_all()
         self._evict_victims(victims)
+        cb = self.on_warm
+        if cb is not None:
+            try:
+                cb(doc_id, ol)
+            except Exception:   # pragma: no cover - warm is best-effort
+                pass
         return ol
 
     # ---- resolve (the scheduler's document authority) --------------------

@@ -12,6 +12,12 @@ the shard count changes, only the docs whose argmax shard changed move
 them. `rebalance()` makes that movement explicit: it returns exactly the
 docs that moved so the caller can drain/flush their sessions before the
 new placement takes effect.
+
+The elastic-mesh rebalancer adds one escape hatch: `pin(doc_id, shard)`
+overrides the hash for a specific doc (a host absorbing a migrated hot
+doc steers it onto its least-loaded shard). Pins are local,
+process-lifetime state — cross-host placement authority lives in the
+replication tier's PlacementOverrides table, not here.
 """
 
 from __future__ import annotations
@@ -38,8 +44,24 @@ class ShardRouter:
         self.n_shards = n_shards
         self.salt = salt.encode("utf8")
         self.assignments: Dict[str, int] = {}
+        # rebalancer pins: doc -> shard, consulted before the hash
+        self.pins: Dict[str, int] = {}
+
+    def pin(self, doc_id: str, shard: int) -> None:
+        if not (0 <= shard < self.n_shards):
+            raise ValueError("shard out of range")
+        self.pins[doc_id] = shard
+        # a live assignment must follow the pin or counts() lies
+        if doc_id in self.assignments:
+            self.assignments[doc_id] = shard
+
+    def unpin(self, doc_id: str) -> None:
+        self.pins.pop(doc_id, None)
 
     def shard_of(self, doc_id: str) -> int:
+        pinned = self.pins.get(doc_id)
+        if pinned is not None and pinned < self.n_shards:
+            return pinned
         best, best_score = 0, -1
         for s in range(self.n_shards):
             sc = _score(doc_id, s, self.salt)

@@ -330,6 +330,46 @@ def cmd_replicate_soak(args) -> int:
                                 {}).get("acyclic", True)) else 1
 
 
+def cmd_rebalance_soak(args) -> int:
+    """Flash-crowd elastic-mesh soak: a hot doc saturates its owner,
+    the SLO burns, and the rebalancer must migrate the doc (epoch-
+    fenced handoff + placement override), absorb a mid-run join, roll
+    back a seeded failed migration, and return the SLO to ok — all
+    without operator action (see replicate/rebalance_soak.py)."""
+    from ..replicate.rebalance_soak import run_rebalance_soak
+    report = run_rebalance_soak(
+        servers=args.servers, docs=args.docs, seed=args.seed,
+        capacity=args.capacity, crowd_boost=args.crowd_boost,
+        flash_crowd=args.flash_crowd, join=args.join,
+        inject_abort=args.inject_abort, progress=args.progress)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        journey = " -> ".join(
+            s for i, s in enumerate(report["slo_states"])
+            if i == 0 or s != report["slo_states"][i - 1]) or "ok"
+        print(f"rebalance-soak: {report['config']['servers']}+"
+              f"{1 if report['joined'] else 0} servers / "
+              f"{report['config']['docs']} docs, "
+              f"{report['edits_applied']} edits, slo {journey}, "
+              f"{len(report['migrations'])} migrations"
+              + (f", join absorbed" if report["joined"]
+                 and report["join_absorbed"] else "")
+              + (", abort rollback "
+                 + ("OK" if report["abort_rollback_ok"] else "BROKEN")
+                 if report["abort_rollback_ok"] is not None else "")
+              + ", split-brain: "
+              + ("NONE" if report["zero_split_brain"]
+                 else ",".join(report["split_brain"]))
+              + f" in {report['wall_s']}s: "
+              + ("CONVERGED" if report["converged"] else "DIVERGED")
+              + (" OK" if report["ok"] else " FAILED"))
+    return 0 if report["ok"] else 1
+
+
 def cmd_storage_soak(args) -> int:
     """Churn docs through an undersized residency tier (cold snapshot
     store -> warm hydrator -> scheduler) with seeded fault injection —
@@ -827,6 +867,37 @@ def main(argv=None) -> int:
     c.set_defaults(fn=cmd_replicate_soak)
 
     c = sub.add_parser(
+        "rebalance-soak",
+        help="flash-crowd elastic-mesh soak: SLO-driven hot-doc "
+        "rebalancing with mid-run scale-out, seeded migration abort, "
+        "and zero-split-brain / convergence gates")
+    c.add_argument("--servers", type=int, default=3)
+    c.add_argument("--docs", type=int, default=8)
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--capacity", type=int, default=5,
+                   help="held-lease count a host serves without "
+                   "latency penalty in the soak's load model")
+    c.add_argument("--crowd-boost", type=int, default=3,
+                   help="extra load the flash crowd puts on whichever "
+                   "host currently owns the hot doc")
+    c.add_argument("--flash-crowd", action="store_true",
+                   help="run the full acceptance journey: ok -> "
+                   "burning -> rebalance -> ok (without it only the "
+                   "healthy phase runs)")
+    c.add_argument("--join", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="join a fresh host on the first non-ok SLO "
+                   "evaluation and require it to absorb load")
+    c.add_argument("--inject-abort",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="aim one migration at an unreachable target "
+                   "and require a clean rollback")
+    c.add_argument("--progress", action="store_true")
+    c.add_argument("--json", action="store_true")
+    c.add_argument("--metrics-out")
+    c.set_defaults(fn=cmd_rebalance_soak)
+
+    c = sub.add_parser(
         "storage-soak",
         help="fault-injected tiered-residency soak: churn docs "
         "through an undersized warm tier and gate on byte-identical "
@@ -919,7 +990,8 @@ def main(argv=None) -> int:
         "safety invariants at every state")
     c.add_argument("--scenario",
                    help="explore one scenario by name — handoff, "
-                   "crash-recovery, renewal, tiebreak (default: all)")
+                   "crash-recovery, renewal, tiebreak, migration "
+                   "(default: all)")
     c.add_argument("--depth", type=int, default=None,
                    help="interleaving depth bound (default 4; under "
                    "--mutate each mutation's own catch depth)")

@@ -1,0 +1,15 @@
+"""Seeded dt-lint fixture: rebalancer planning lock-order violation.
+
+Acquires the rebalancer's planning guard (repl.rebalance, 1) while
+already holding the lease lock (repl.leases, 2) — backwards against
+the canonical order: migration planning reads lease state (plan ->
+lease), lease code must never call back into the planner.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureRebalancer:
+    def backwards(self, doc_id):
+        with self.leases.lock:
+            with self._rebalance_lock:
+                return self._last_attempt.get(doc_id)

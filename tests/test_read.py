@@ -220,7 +220,7 @@ def test_metrics_fixed_keys_and_snapshot_shape():
     m.bump("proxied_staleness")
     m.observe_staleness(0.25)
     snap = m.snapshot()
-    assert snap["version"] == 1
+    assert snap["version"] == 2
     assert set(snap["counters"]) == set(READ_KEYS)
     assert snap["proxied"] == 1
     assert snap["local_ratio"] == pytest.approx(0.75)
@@ -443,4 +443,4 @@ def test_read_bench_smoke_end_to_end():
     assert report["follower"]["local"] == 20
     assert report["control"]["proxied"] == 20
     for snap in report["read_metrics"].values():
-        assert snap["version"] == 1
+        assert snap["version"] == 2

@@ -1,0 +1,326 @@
+"""Elastic mesh (replicate/rebalance.py + rebalance_soak.py).
+
+Three layers:
+
+  * `PlacementOverrides` in isolation: version monotonicity, the
+    LWW merge rule (higher version wins, equal version resolves to
+    the lexically smaller target with tombstones smallest), gossip
+    payload shape + cap, and the journal round-trip that makes
+    placement survive crash-restart;
+  * `Rebalancer` against a stub node: the tick only plans under
+    stress, picks the least-loaded HEALTHY peer, honors the
+    min-load-gap damper and per-doc cooldown, and rolls an aborted
+    migration all the way back (override tombstoned, counter bumped);
+  * the full `rebalance-soak` acceptance run: flash crowd drives the
+    SLO ok -> burning -> ok with at least one live migration, a host
+    joined mid-soak absorbs load, the injected abort rolls back, and
+    the mesh reconverges with zero split-brain.
+"""
+
+import pytest
+
+from diamond_types_tpu.replicate.metrics import ReplicationMetrics
+from diamond_types_tpu.replicate.rebalance import (PlacementOverrides,
+                                                   Rebalancer)
+from diamond_types_tpu.replicate.rebalance_soak import run_rebalance_soak
+
+pytestmark = pytest.mark.elastic
+
+
+# ---- PlacementOverrides ---------------------------------------------------
+
+def test_override_set_clear_versions_are_monotonic():
+    t = PlacementOverrides()
+    assert t.target_of("d0") is None
+    assert t.version_of("d0") == 0
+    assert t.set("d0", "hostB") == 1
+    assert t.target_of("d0") == "hostB"
+    assert t.set("d0", "hostC") == 2
+    assert t.target_of("d0") == "hostC"
+    assert t.size() == 1
+    # clear is a tombstone at a BUMPED version, not a delete
+    assert t.clear("d0") == 3
+    assert t.target_of("d0") is None
+    assert t.version_of("d0") == 3
+    assert t.size() == 0
+    assert t.as_json() == {"d0": {"target": None, "ver": 3}}
+
+
+def test_merge_precedence_higher_version_wins():
+    t = PlacementOverrides()
+    t.set("d0", "hostB")                        # ver 1
+    assert t.merge([["d0", "hostC", 5]]) == 1   # newer wins
+    assert t.target_of("d0") == "hostC"
+    assert t.version_of("d0") == 5
+    assert t.merge([["d0", "hostZ", 3]]) == 0   # stale ignored
+    assert t.target_of("d0") == "hostC"
+    # a newer tombstone retracts a set entry
+    assert t.merge([["d0", None, 6]]) == 1
+    assert t.target_of("d0") is None
+    assert t.version_of("d0") == 6
+
+
+def test_merge_equal_version_resolves_to_smaller_target():
+    """Equal versions must converge without coordination: lexically
+    smaller target wins, and a tombstone sorts below every target —
+    any fold order reaches the same table."""
+    t = PlacementOverrides()
+    t.merge([["d0", "hostB", 2]])
+    assert t.merge([["d0", "hostC", 2]]) == 0   # larger target loses
+    assert t.target_of("d0") == "hostB"
+    assert t.merge([["d0", "hostA", 2]]) == 1   # smaller target wins
+    assert t.target_of("d0") == "hostA"
+    assert t.merge([["d0", None, 2]]) == 1      # tombstone is smallest
+    assert t.target_of("d0") is None
+    assert t.merge([["d0", "hostA", 2]]) == 0   # ...and sticks
+    # fold the same three entries in the opposite order on a second
+    # table: both converge to the tombstone at ver 2
+    u = PlacementOverrides()
+    u.merge([["d0", None, 2]])
+    u.merge([["d0", "hostA", 2]])
+    u.merge([["d0", "hostC", 2]])
+    assert u.as_json() == t.as_json()
+
+
+def test_merge_rejects_malformed_rows():
+    t = PlacementOverrides()
+    assert t.merge("not-a-list") == 0
+    assert t.merge([["d0", "hostB"],            # wrong arity
+                    ["d1", "hostB", "notint"],  # bad version type
+                    [7, "hostB", 1],            # bad doc type
+                    ["d2", 9, 1],               # bad target type
+                    ["d3", "hostB", 1]]) == 1   # the one valid row
+    assert t.as_json() == {"d3": {"target": "hostB", "ver": 1}}
+
+
+def test_gossip_payload_roundtrips_and_caps():
+    t = PlacementOverrides()
+    for i in range(8):
+        t.set(f"d{i}", "hostB")
+    t.clear("d3")
+    payload = t.gossip_payload()
+    # tombstones ride the payload like sets so clears propagate
+    assert ["d3", None, 2] in payload
+    fresh = PlacementOverrides()
+    assert fresh.merge(payload) == 8
+    assert fresh.as_json() == t.as_json()
+    assert len(t.gossip_payload(cap=3)) == 3
+
+
+class _JournalStub:
+    def __init__(self):
+        self.rows = {}
+
+    def note_override(self, doc, target, ver):
+        self.rows[doc] = {"target": target, "ver": ver}
+
+    def restored_overrides(self):
+        return dict(self.rows)
+
+
+def test_overrides_journal_roundtrip_including_tombstones():
+    j = _JournalStub()
+    t = PlacementOverrides(journal=j)
+    t.set("d0", "hostB")
+    t.set("d1", "hostC")
+    t.clear("d1")
+    # merged-in entries are journaled too: EVERY host's placement must
+    # survive a crash, not just the migration initiator's
+    t.merge([["d2", "hostB", 4]])
+    restored = PlacementOverrides(journal=j)
+    assert restored.as_json() == t.as_json()
+    assert restored.target_of("d0") == "hostB"
+    assert restored.target_of("d1") is None
+    assert restored.version_of("d1") == 2
+    assert restored.version_of("d2") == 4
+
+
+def test_overrides_bump_rebalance_metrics():
+    m = ReplicationMetrics("hostA")
+    t = PlacementOverrides(metrics=m)
+    t.set("d0", "hostB")
+    t.clear("d0")
+    t.merge([["d1", "hostC", 3]])
+    assert m.get("rebalance", "overrides_set") == 1
+    assert m.get("rebalance", "overrides_cleared") == 1
+    assert m.get("rebalance", "override_merges") == 1
+
+
+# ---- Rebalancer against a stub node ---------------------------------------
+
+class _Leases:
+    def __init__(self, held):
+        self.held = list(held)
+
+    def held_ids(self):
+        return list(self.held)
+
+    def held_count(self):
+        return len(self.held)
+
+
+class _Membership:
+    def __init__(self, members):
+        self.members = list(members)
+
+    def universe(self):
+        return list(self.members)
+
+
+class _Table:
+    def __init__(self, down=()):
+        self.down = set(down)
+
+    def is_healthy(self, m):
+        return m not in self.down
+
+
+class _Slo:
+    def __init__(self, state):
+        self.state = state
+
+    def evaluate(self):
+        return [{"name": "soak_edit_rtt", "state": self.state}]
+
+
+class _Obs:
+    def __init__(self, state="ok"):
+        self.slo = _Slo(state)
+
+
+class _Node:
+    """Just enough ReplicaNode surface for Rebalancer: leases,
+    membership view, gossiped peer loads, overrides, metrics and an
+    instrumented handoff whose outcome the test controls."""
+
+    def __init__(self, held=("d1", "d2", "d3"),
+                 peers=("hostB", "hostC"), down=(),
+                 peer_load=None, handoff_ok=True):
+        self.self_id = "hostA"
+        self.leases = _Leases(held)
+        self.membership = _Membership([self.self_id, *peers])
+        self.table = _Table(down)
+        self.peer_load = dict(peer_load or {})
+        self.metrics = ReplicationMetrics(self.self_id)
+        self.overrides = PlacementOverrides(metrics=self.metrics)
+        self.obs = None
+        self.rejoining = False
+        self.store = object()           # no scheduler: parking no-ops
+        self.handoff_ok = handoff_ok
+        self.handoffs = []
+        self._now = 100.0
+
+    def clock(self):
+        return self._now
+
+    def handoff(self, doc_id, target, override_version=None):
+        self.handoffs.append((doc_id, target, override_version))
+        return self.handoff_ok
+
+
+def test_tick_is_a_noop_when_healthy_or_disabled():
+    n = _Node()
+    rb = Rebalancer(n, obs=_Obs("ok"))
+    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": []}
+    assert n.handoffs == []
+    # stressed but disabled / rejoining: still a no-op
+    rb2 = Rebalancer(n, obs=_Obs("burning"), enabled=False)
+    assert rb2.tick()["migrated"] == []
+    n.rejoining = True
+    rb3 = Rebalancer(n, obs=_Obs("burning"))
+    assert rb3.tick()["migrated"] == []
+    assert n.handoffs == []
+
+
+def test_act_on_narrows_the_trigger_states():
+    # a conservative deployment acts only on burning: warnings are
+    # not stress, burning still is
+    n = _Node(peer_load={"hostB": 0, "hostC": 1})
+    rb = Rebalancer(n, obs=_Obs("warning"), act_on=("burning",))
+    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": []}
+    rb2 = Rebalancer(n, obs=_Obs("burning"), act_on=("burning",))
+    assert rb2.tick()["migrated"] == [["d1", "hostB"]]
+
+
+def test_stressed_tick_migrates_offender_to_least_loaded_peer():
+    n = _Node(peer_load={"hostB": 0, "hostC": 1})
+    rb = Rebalancer(n, obs=_Obs("burning"))
+    out = rb.tick()
+    assert out["stressed"] == ["soak_edit_rtt"]
+    # one migration per tick, lexically-first doc (cold sketch), to the
+    # least-loaded peer; the override version rides the handoff
+    assert out["migrated"] == [["d1", "hostB"]]
+    assert n.handoffs == [("d1", "hostB", 1)]
+    assert n.overrides.target_of("d1") == "hostB"
+    assert n.metrics.get("rebalance", "migrations_started") == 1
+    assert n.metrics.get("rebalance", "migrations_completed") == 1
+
+
+def test_unhealthy_peer_is_never_a_target():
+    n = _Node(peer_load={"hostB": 0, "hostC": 1}, down=("hostB",))
+    rb = Rebalancer(n, obs=_Obs("warning"))
+    assert rb.tick()["migrated"] == [["d1", "hostC"]]
+
+
+def test_min_load_gap_dampens_ping_pong():
+    # every peer within the gap of our own load: stressed but nowhere
+    # worth shedding to — plan must stay empty
+    n = _Node(held=("d1", "d2"), peer_load={"hostB": 2, "hostC": 2})
+    rb = Rebalancer(n, obs=_Obs("burning"), min_load_gap=1)
+    out = rb.tick()
+    assert out["stressed"] and out["migrated"] == []
+    assert n.handoffs == []
+
+
+def test_cooldown_blocks_immediate_retry_of_same_doc():
+    n = _Node(held=("d1",), peer_load={"hostB": 0, "hostC": 5})
+    rb = Rebalancer(n, obs=_Obs("burning"), cooldown_s=3.0)
+    assert rb.tick()["migrated"] == [["d1", "hostB"]]
+    assert rb.tick()["migrated"] == []       # same instant: cooling
+    n._now += 5.0
+    assert rb.tick()["migrated"] == [["d1", "hostB"]]
+    assert len(n.handoffs) == 2
+
+
+def test_aborted_migration_rolls_back_override():
+    n = _Node(held=("d1",), peer_load={"hostB": 0, "hostC": 5},
+              handoff_ok=False)
+    rb = Rebalancer(n, obs=_Obs("burning"))
+    out = rb.tick()
+    assert out["aborted"] == [["d1", "hostB"]]
+    assert out["migrated"] == []
+    # override tombstoned (set at ver 1, cleared at ver 2): routing
+    # stays at the source and the clear gossips over the stale set
+    assert n.overrides.target_of("d1") is None
+    assert n.overrides.version_of("d1") == 2
+    assert n.metrics.get("rebalance", "migrations_started") == 1
+    assert n.metrics.get("rebalance", "migrations_completed") == 0
+    assert n.metrics.get("rebalance", "migrations_aborted") == 1
+
+
+# ---- the soak: flash crowd end-to-end --------------------------------------
+
+def test_flash_crowd_soak_migrates_joins_and_recovers():
+    """One full rebalance-soak run (the CLI acceptance gate) asserted
+    field by field: the flash crowd burns the SLO, the rebalancer
+    sheds the hot doc, the mid-soak joiner absorbs load, the injected
+    abort rolls back cleanly, and the mesh reconverges byte-identical
+    with zero split-brain."""
+    rep = run_rebalance_soak()
+    assert rep["ok"], rep
+    assert rep["settled"]
+    # the SLO journey: healthy -> burning under the crowd -> back to ok
+    assert rep["slo_states"][0] == "ok"
+    assert rep["burning_seen"]
+    assert rep["slo_states"][-1] == "ok"
+    assert rep["slo_journey_ok"]
+    # at least one live migration moved the hot doc off the burning host
+    assert len(rep["migrations"]) >= 1
+    # scale-out: the host joined at first stress ended up holding load
+    assert rep["joined"]
+    assert rep["join_absorbed"]
+    # the abort injection rolled back: holder unchanged, override
+    # tombstoned, migrations_aborted bumped
+    assert rep["abort_rollback_ok"]
+    assert rep["converged"]
+    assert rep["zero_split_brain"]
