@@ -353,6 +353,109 @@ def bench_device_merge(corpus: str, chunk: int, timeout: int = 420,
     return _run_device_bench_retry(code, timeout)
 
 
+_TRANSFORM_SNIPPET = _PRELUDE + """
+import numpy as _np
+from diamond_types_tpu.text.oplog import OpLog
+from diamond_types_tpu.tpu.flush_fuse import FusedDocSession, fused_replay
+from diamond_types_tpu.tpu.xform import (TailExtract, extract_tail,
+                                         resolve_positions)
+
+docs, branches, edits = {docs}, {branches}, {edits}
+# Tail text sampled from the flagship corpus checkout when the
+# benchmark_data tree exists; deterministic synthetic words otherwise.
+# Transform cost is shape-driven (tail rows x branch fanout), not
+# content-driven, so the synthetic numbers stay comparable.
+words = None
+try:
+    from diamond_types_tpu.encoding.decode import load_oplog
+    _txt = load_oplog(open({data!r}, 'rb').read())\\
+        .checkout_tip().snapshot()
+    words = [_txt[i:i + 7].replace("\\x00", " ") or "mk"
+             for i in range(0, 7 * 8192, 7)]
+    print("CORPUS 1")
+except Exception:
+    print("CORPUS 0")
+
+def _word(k):
+    return words[k % len(words)] if words else "w%05d " % (k % 99991)
+
+sessions, oplogs = [], []
+for di in range(docs):
+    ol = OpLog()
+    ol.doc_id = "doc-%d" % di
+    ags = [ol.get_or_create_agent_id("a%d" % b) for b in range(branches)]
+    ol.add_insert_at(ags[0], [], 0, "seed ")
+    sess = FusedDocSession(ol, cap=8192, max_ins=16)
+    base = list(ol.version)
+    # `branches` concurrent linear runs forked at the session frontier:
+    # every run is concurrent with every other run -- the conflict-zone
+    # shape the device transform exists for.
+    for b in range(branches):
+        head, pos = base, 0
+        for j in range(edits):
+            w = _word((di * branches + b) * edits + j)
+            lv = ol.add_insert_at(ags[b], head, pos, w)
+            head, pos = [lv], pos + len(w)
+    sessions.append(sess)
+    oplogs.append(ol)
+tail_lvs = sum(len(ol) for ol in oplogs) \\
+    - sum(s.synced_to for s in sessions)
+
+# Host control: the tracker-walk plan (plan_tail is a pure read, so the
+# same tails can be planned repeatedly).
+reps = 3
+host_ts = []
+for _ in range(reps):
+    t0 = time.perf_counter()
+    host_plans = [s.plan_tail() for s in sessions]
+    host_ts.append(time.perf_counter() - t0)
+host_dt = min(host_ts)
+
+exts = [extract_tail(s) for s in sessions]
+n_dev = sum(isinstance(e, TailExtract) for e in exts)
+print("DEVICE_DOCS", n_dev)
+assert n_dev == docs, "extract_tail fell back on %d docs" % (docs - n_dev)
+plans = resolve_positions(exts)   # warmup/compile
+assert all(p is not None for p in plans), "device transform fell back"
+# Device timing is end to end: host origin extraction + the jitted
+# order/visibility/position kernel (apples to apples with plan_tail).
+dt = bench_call(lambda: resolve_positions([extract_tail(s)
+                                           for s in sessions]),
+                lambda ps: ps[0].pos, reps=reps)
+
+# Parity: replay the device-planned tails through the fused kernel and
+# compare every doc against the host oracle checkout.
+oks, _ = fused_replay(sessions, plans)
+assert all(oks), "poison fence tripped during parity replay"
+for s, ol in zip(sessions, oplogs):
+    assert s.text() == ol.checkout_tip().snapshot(), \\
+        "device transform diverged (%s)" % ol.doc_id
+print("PARITY_CHECKED 1")
+print("HOST_PLAN_MS", round(host_dt * 1e3, 3))
+print("DEVICE_PLAN_MS", round(dt * 1e3, 3))
+print("TRANSFORM_SPEEDUP", round(host_dt / max(dt, 1e-9), 3))
+print("RESULT", tail_lvs / dt)
+"""
+
+
+def bench_device_transform(corpus: str = "git-makefile.dt",
+                           docs: int = 8, branches: int = 8,
+                           edits: int = 24, timeout: int = 300):
+    """Device-resident tail transform (tpu/xform.py): `docs` sessions
+    each carrying a `branches`-way concurrent tail, merge positions
+    resolved on device (Fugue linearization + split-run visibility)
+    vs. the host tracker walk on identical tails. Parity-gated by
+    replaying the device plans through the fused kernel and comparing
+    every doc to the host checkout. Falls back to synthetic tail text
+    when the corpus tree is absent (shape, not content, drives the
+    transform's cost)."""
+    code = _TRANSFORM_SNIPPET.format(
+        repo=os.path.dirname(os.path.abspath(__file__)),
+        data=os.path.join(BENCH_DATA, corpus),
+        docs=docs, branches=branches, edits=edits, liveness=LIVENESS_S)
+    return _run_device_bench_retry(code, timeout)
+
+
 _ZONE_MERGE_SNIPPET = _PRELUDE + """
 import numpy as _np
 from diamond_types_tpu.encoding.decode import load_oplog
@@ -775,7 +878,12 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
                       engine: str = "device", timeout: int = 300,
                       fused: bool = True, steady_rounds: int = 8,
                       mesh_window: bool = False,
-                      telemetry: bool = True):
+                      telemetry: bool = True,
+                      mode: str = "trace",
+                      flush_docs: int = None,
+                      max_sessions: int = None,
+                      device_plan: bool = False,
+                      pallas: bool = False):
     """Sharded multi-document merge scheduler (serve/): replays the
     synthetic trace across `docs` docs on `shards` CPU-simulated shards
     through the router + shape-bucketed admission queue + per-shard
@@ -791,12 +899,27 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
     `mesh_window` routes flushes through the mesh flush-window
     coordinator: one shard_map dispatch per window instead of one
     device call per shard (the report's device_calls_per_window is the
-    A/B signal)."""
+    A/B signal). `device_plan` resolves concurrent merge positions on
+    device (tpu/xform.py) instead of the host tracker walk; `pallas`
+    adds the Pallas step-kernel rung at the top of the flush ladder.
+    The transform A/B needs `mode="concurrent"` (a linear trace has no
+    conflict zone — the device rung falls back per design) and
+    `max_sessions >= docs` (residency thrash rebuilds sessions
+    caught-up, leaving the transform nothing to plan)."""
     cmd = [sys.executable, "-m", "diamond_types_tpu.tools.cli",
            "serve-bench", "--shards", str(shards), "--docs", str(docs),
            "--txns", str(txns), "--engine", engine,
            "--fused" if fused else "--no-fused",
-           "--steady-rounds", str(steady_rounds), "--json"]
+           "--steady-rounds", str(steady_rounds), "--json",
+           "--mode", mode]
+    if flush_docs is not None:
+        cmd += ["--flush-docs", str(flush_docs)]
+    if max_sessions is not None:
+        cmd += ["--max-sessions", str(max_sessions)]
+    if device_plan:
+        cmd.append("--device-plan")
+    if pallas:
+        cmd.append("--pallas")
     if mesh_window:
         cmd.append("--mesh-window")
     if fused:
@@ -857,6 +980,7 @@ DEVICE_BENCHES = (
     "tpu_zone_git_makefile",
     "tpu_zone_friendsforever",
     "tpu_session_friendsforever",
+    "tpu_transform_git_makefile",
     "tpu_batched_replay",
     "fanin_10k",
 )
@@ -1137,6 +1261,22 @@ def _run_device_phase_locked(full: dict, probe: dict,
             out["tpu_session_build_ms"] = r.get("build_ms")
     else:
         out["tpu_session_friendsforever_error"] = _short_err(r)
+
+    # Device-resident tail transform vs. the host tracker walk on the
+    # same concurrent tails (the serve ladder's planning stage; corpus
+    # text when present, synthetic tails otherwise — see the snippet).
+    r = guarded("tpu_transform_git_makefile",
+                lambda: bench_device_transform())
+    if r.get("ok"):
+        out["tpu_transform_git_makefile_ops_per_sec"] = round(r["value"])
+        if r.get("transform_speedup") is not None:
+            out["tpu_transform_speedup"] = r["transform_speedup"]
+        if r.get("device_plan_ms") is not None:
+            out["tpu_transform_device_plan_ms"] = r["device_plan_ms"]
+        if r.get("host_plan_ms") is not None:
+            out["tpu_transform_host_plan_ms"] = r["host_plan_ms"]
+    else:
+        out["tpu_transform_git_makefile_error"] = _short_err(r)
 
     r = guarded("tpu_batched_replay", bench_tpu_batch)
     if r.get("ok"):
@@ -1453,6 +1593,38 @@ def _main() -> None:
             extra["serve_sched"]["slo_ok"] = sv.get("slo_ok")
         except Exception as e:  # pragma: no cover
             extra["serve_sched"]["telemetry_error"] = str(e)[:120]
+        # device-plan transform A/B on a CONCURRENT trace: host tracker
+        # walk (control) vs. the device transform rung + Pallas replay
+        # on the same schedule. A concurrent mode + resident sessions
+        # (max_sessions >= docs, steady rounds) are required for the
+        # rung to engage at all — a linear trace has no conflict zone
+        # and evicted sessions rebuild caught-up (empty tails).
+        try:
+            xkw = dict(mode="concurrent", shards=2, docs=6, txns=6,
+                       flush_docs=3, max_sessions=8, steady_rounds=8)
+            svc = bench_serve_sched(**xkw)          # host-plan control
+            svx = bench_serve_sched(device_plan=True, pallas=True,
+                                    **xkw)
+            full["serve_sched_xform_host"] = svc
+            full["serve_sched_xform"] = svx
+            tr = svx.get("transform") or {}
+            extra["serve_sched_xform"] = {
+                "parity": svx["parity_ok"],
+                "ops_per_sec": svx["ops_per_sec"],
+                "host_plan_ops_per_sec": svc["ops_per_sec"],
+                "device_docs": tr.get("device_docs"),
+                "host_docs": tr.get("host_docs"),
+                "fallbacks": tr.get("fallbacks"),
+                "device_ratio": tr.get("device_ratio"),
+                "pallas_jit": (svx.get("devprof") or {})
+                    .get("jit_cache", {}).get("pallas"),
+            }
+            if svc.get("feed_wall_s") and svx.get("feed_wall_s"):
+                extra["serve_sched_xform"]["transform_speedup"] = round(
+                    svc["feed_wall_s"] / max(svx["feed_wall_s"], 1e-9),
+                    3)
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched_xform_error"] = str(e)[:120]
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 

@@ -59,6 +59,8 @@ def _bench_of(key: str):
         return "tpu_merge_node_nodecc_sweep"
     if key.startswith("tpu_session"):
         return "tpu_session_friendsforever"
+    if key.startswith("tpu_transform"):
+        return "tpu_transform_git_makefile"
     for b in BENCHES:
         if key.startswith(b):
             return b

@@ -112,6 +112,13 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     # stays outer to oplog and never the reverse
     if "_read_lock" in src or "_cache_lock" in src:
         return "io"
+    # device-transform planning: the xform jit-cache guard is a
+    # DEVICE-class lock (the batched transform dispatch runs in the
+    # planning phase, under shard locks but outside the oplog guard and
+    # the per-device replay locks) — must classify BEFORE the generic
+    # "_jit_lock" leaf rule below
+    if "_xform_jit_lock" in src:
+        return "device"
     if "_first_touch_lock" in src or "_jit_lock" in src:
         return "leaf"
     # live-telemetry tier: the TimeSeries ring guard (`_ts_lock`, also

@@ -173,7 +173,15 @@ def mesh_fused_replay(mesh: Mesh, sessions, plans):
     and the returned-length fence are byte-identical to `fused_replay`
     (`adopt_results` is shared), so the bank's fallback ladder catches
     violating rows exactly as before — and a violating doc in one
-    shard cannot corrupt another shard's rows."""
+    shard cannot corrupt another shard's rows.
+
+    Device-planned tails (serve banks built with `device_plan=True`)
+    need no special handling here: by the time a row reaches this rung
+    its transform has already resolved into a plain doc-order
+    `TailPlan` (tpu/xform.py resolve_positions), indistinguishable
+    from a host tracker-walk plan — the mesh rung consumes either
+    unchanged, and a transform fallback upstream simply arrives as a
+    host plan."""
     import time
 
     import jax.numpy as jnp

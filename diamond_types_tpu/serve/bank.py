@@ -112,7 +112,9 @@ class SessionBank:
                  fused_opts: Optional[dict] = None,
                  warmup: bool = False,
                  flush_docs: int = 8,
-                 mesh_shards: int = 0) -> None:
+                 mesh_shards: int = 0,
+                 device_plan: bool = False,
+                 pallas: bool = False) -> None:
         if engine not in ("device", "host"):
             raise ValueError(f"unknown engine {engine!r}")
         self.shard_id = shard_id
@@ -133,6 +135,14 @@ class SessionBank:
         # shape classes (B padded to the mesh) so the first window
         # doesn't eat a cold compile
         self.mesh_shards = int(mesh_shards)
+        # device_plan routes tail PLANNING through the device transform
+        # (tpu/xform.py plan_tails_device) instead of the host tracker
+        # walk; pallas routes the fused REPLAY through the Pallas step
+        # kernel rung (flush_fuse.pallas_fused_replay), falling back to
+        # the XLA fused rung on any failure. Both only apply on the
+        # fused device engine.
+        self.device_plan = bool(device_plan) and self.fused
+        self.pallas = bool(pallas) and self.fused
         self.sessions: "OrderedDict[str, object]" = OrderedDict()
         self._resyncs_seen: Dict[str, int] = {}
         # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
@@ -158,12 +168,16 @@ class SessionBank:
         try:
             _ensure_jax_ready()
             from ..tpu.flush_fuse import (DEFAULT_CAP, DEFAULT_MAX_INS,
+                                          WARMUP_SHAPE_CLASSES,
                                           warmup_fused_cache)
             warmup_fused_cache(
                 flush_docs=self.flush_docs,
                 cap=self.fused_opts.get("cap", DEFAULT_CAP),
                 max_ins=self.fused_opts.get("max_ins", DEFAULT_MAX_INS),
-                mesh_shards=self.mesh_shards)
+                mesh_shards=self.mesh_shards,
+                xform_classes=(WARMUP_SHAPE_CLASSES if self.device_plan
+                               else ()),
+                pallas=self.pallas)
         except Exception:   # pragma: no cover - warmup must never wedge
             pass
 
@@ -439,15 +453,19 @@ class SessionBank:
         # device lock ONLY — host threads keep mutating other oplogs
         failed: List[str] = []
         for sessions, plans, doc_ids in win["groups"]:
-            from ..tpu.flush_fuse import fused_replay
+            from ..tpu.flush_fuse import fused_replay, pallas_fused_replay
             t0 = time.perf_counter()
             with dlock:
                 if self.device is not None:
                     import jax
                     with jax.default_device(self.device):
-                        ok, device_s = fused_replay(sessions, plans)
+                        ok, device_s = self._replay_group(
+                            sessions, plans, fused_replay,
+                            pallas_fused_replay)
                 else:
-                    ok, device_s = fused_replay(sessions, plans)
+                    ok, device_s = self._replay_group(
+                        sessions, plans, fused_replay,
+                        pallas_fused_replay)
             wall = time.perf_counter() - t0
             n = len(sessions)
             fused_calls += 1
@@ -466,18 +484,38 @@ class SessionBank:
         out["fused_docs"] = fused_docs
         return out
 
+    def _replay_group(self, sessions, plans, fused_replay,
+                      pallas_fused_replay):
+        """One fused group through the replay ladder's device rungs:
+        the Pallas step kernel when enabled, the XLA fused kernel as
+        its fallback (and on every failure). Commit/fence semantics
+        are identical, so falling through loses nothing but the
+        kernel choice."""
+        if self.pallas:
+            try:
+                return pallas_fused_replay(sessions, plans)
+            except Exception:
+                self._bump("pallas_fallbacks")
+        return fused_replay(sessions, plans)
+
     def _plan_fused(self, items, ols, olock, min_fuse: int = 2):
-        """Host-side phase of the fused flush, under `olock`: get/build
-        each doc's session, plan its tail, and group fusable sessions
-        by (cap, max_ins). Anything that can't fuse — non-fused
-        residency, overflowing tail, LRU-evicted mid-batch, a bucket
-        with fewer than `min_fuse` fusable docs — lands in the serial
-        list."""
+        """Host-side phase of the fused flush: get/build each doc's
+        session, plan its tail, and group fusable sessions by
+        (cap, max_ins). Anything that can't fuse — non-fused residency,
+        overflowing tail, LRU-evicted mid-batch, a bucket with fewer
+        than `min_fuse` fusable docs — lands in the serial list.
+
+        With `device_plan` the planning itself is split the same way
+        the replay is: tail EXTRACTION (native transform + columns)
+        under `olock`, the batched device order/position resolution
+        OUTSIDE it (extracts are self-contained), then adoption and
+        per-doc host re-planning for cross-check failures back under
+        `olock` — the transform ladder's own host rung."""
         from ..tpu.flush_fuse import FusedDocSession
         serial = []
         fusable: List[tuple] = []    # (sess, plan, doc_id)
+        planned = []                 # (it, sess, TailPlan | TailExtract)
         with olock:
-            planned = []
             for it in items:
                 try:
                     sess = self.session(it.doc_id, ols[it.doc_id])
@@ -487,12 +525,41 @@ class SessionBank:
                 if not isinstance(sess, FusedDocSession):
                     serial.append(it)
                     continue
-                plan = sess.plan_tail()
+                if self.device_plan:
+                    from ..tpu.xform import extract_tail
+                    half = extract_tail(sess)   # TailExtract | TailPlan
+                else:
+                    half = sess.plan_tail()
+                planned.append((it, sess, half))
+        if self.device_plan:
+            # device half OUTSIDE the oplog guard: one batched dispatch
+            # resolves every extract's order + positions
+            from ..tpu.xform import TailExtract, resolve_positions
+            ext = [(j, h) for j, (_it, _s, h) in enumerate(planned)
+                   if isinstance(h, TailExtract)]
+            stats = {"device_docs": 0,
+                     "host_docs": len(planned) - len(ext),
+                     "fallbacks": 0, "batches": 1 if ext else 0}
+            if ext:
+                resolved = resolve_positions([h for _, h in ext])
+                for (j, _), plan in zip(ext, resolved):
+                    it, sess, _ = planned[j]
+                    if plan is None:
+                        stats["fallbacks"] += 1
+                    else:
+                        stats["device_docs"] += 1
+                    planned[j] = (it, sess, plan)
+            if self.metrics is not None and (ext or stats["host_docs"]):
+                self.metrics.record_transform(self.shard_id, **stats)
+        with olock:
+            for it, sess, plan in planned:
+                if plan is None:
+                    # device cross-check failed: host re-plan (the
+                    # per-doc host rung of the transform ladder)
+                    plan = sess.plan_tail()
                 if not plan.fits(sess.cap):
                     serial.append(it)   # overflow -> per-doc resync
                     continue
-                planned.append((it, sess, plan))
-            for it, sess, plan in planned:
                 # building session N can LRU-evict already-planned M:
                 # only still-resident sessions may commit device state
                 if self.sessions.get(it.doc_id) is not sess:

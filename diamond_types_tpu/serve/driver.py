@@ -127,7 +127,9 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     warmup: bool = False,
                     steady_rounds: int = 0,
                     mesh_window: bool = False,
-                    telemetry: bool = True) -> dict:
+                    telemetry: bool = True,
+                    device_plan: bool = False,
+                    pallas: bool = False) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
     report with throughput, the metrics snapshot, the parity gate, and
     the device-profiler snapshot (wall vs. device time per flush, jit
@@ -137,7 +139,11 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     flushes through the scheduler's mesh flush-window coordinator (one
     shard_map dispatch per window instead of one device call per
     shard) — the report's `device_calls_per_window` is the direct
-    A/B signal against the per-shard default."""
+    A/B signal against the per-shard default. `device_plan=True` plans
+    flush tails through the device transform (tpu/xform.py) instead of
+    the host tracker walk — the report's `transform` block counts how
+    many tails actually resolved on device — and `pallas=True` adds the
+    Pallas replay rung at the top of the flush ladder."""
     doc_ids = [f"doc{i:03d}" for i in range(docs)]
     ols: Dict[str, OpLog] = {}
     for d in doc_ids:
@@ -179,7 +185,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         place_on_devices=place_on_devices, session_opts=session_opts,
         sync_lock=oplog_lock, fused=fused,
         flush_workers=flush_workers, warmup=warmup,
-        mesh_window=mesh_window)
+        mesh_window=mesh_window, device_plan=device_plan,
+        pallas=pallas)
     obs = Observability(sample_rate=obs_sample_rate, seed=seed,
                         telemetry=telemetry)
     sched.attach_obs(obs)
@@ -276,6 +283,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                    "flush_workers": flush_workers, "warmup": warmup,
                    "steady_rounds": steady_rounds,
                    "mesh_window": sched.mesh_window,
+                   "device_plan": sched.device_plan,
+                   "pallas": sched.pallas,
                    "telemetry": telemetry},
         "total_ops": total_ops,
         "submit_retries": retries,
@@ -293,6 +302,9 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         # per due bucket)
         "device_calls_per_window":
             m["window"]["device_calls_per_window"],
+        # the transform rung's engagement: tails whose merge positions
+        # resolved on device vs. the host tracker walk
+        "transform": m["transform"],
         "metrics": m,
         "devprof": PROFILER.snapshot(),
         "obs": {"trace": obs.tracer.stats(),

@@ -26,6 +26,8 @@ Schema (snapshot()):
               "docs", "mesh_docs", "mesh_padded_rows",
               "mesh_occupancy",               # docs / padded rows
               "shards_hist": {"2": n, ...}},  # shards per window
+   "transform": {"device_docs", "host_docs", "fallbacks", "batches",
+                 "device_ratio"},             # device tail planning
    "hydration": {"prefetches", "warm_hits", "hydrations", ...},
                                     # the residency tier's counter set
                                     # (HYDRATION_KEYS; all zero until a
@@ -53,7 +55,7 @@ from ..obs.hist import Histogram
 _SHARD_KEYS = ("submits", "coalesced", "rejects", "denied", "fenced",
                "flushes", "flushed_docs", "flushed_ops", "builds",
                "evictions", "resyncs", "syncs", "host_fallbacks",
-               "fused_calls", "fused_docs")
+               "fused_calls", "fused_docs", "pallas_fallbacks")
 
 # the residency tier's counter set (serve.hydrate.Hydrator feeds these
 # through record_hydration; hydrate.py imports the tuple so the two
@@ -104,8 +106,13 @@ class ServeMetrics:
     # v9 = `latencies.queue_wait` (admit -> flush-start wait per merged
     # item, the admission-SLO signal) + the live-telemetry double-write
     # (`ts` TimeSeries, wired by attach_obs: every counter/latency also
-    # lands in the windowed ring so rate()/quantile() answer "now")
-    SCHEMA_VERSION = 9
+    # lands in the windowed ring so rate()/quantile() answer "now");
+    # v10 = the `transform` block (device-resident tail planning,
+    # tpu/xform.py: docs planned on device vs. the host tracker walk,
+    # per-doc cross-check fallbacks, batched dispatches) + the
+    # `pallas_fallbacks` shard counter (Pallas replay rung failures
+    # that fell to the XLA fused rung)
+    SCHEMA_VERSION = 10
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -130,6 +137,12 @@ class ServeMetrics:
         self.mesh_docs = 0           # docs replayed via the mesh prog
         self.mesh_padded_rows = 0    # super-batch rows incl. padding
         self.window_shards_hist: Dict[int, int] = {}
+        # device-transform planning accounting (scheduler-level: the
+        # batched dispatch is shared across a bucket)
+        self.xform_device_docs = 0   # tails planned by the device xform
+        self.xform_host_docs = 0     # tails the extractor host-planned
+        self.xform_fallbacks = 0     # device cross-check -> host re-plan
+        self.xform_batches = 0       # batched xform dispatches
         self.max_depth_seen = 0
         self.queue_bound_violations = 0
         self.queue_depth: List[int] = [0] * n_shards
@@ -211,6 +224,22 @@ class ServeMetrics:
             self.mesh_padded_rows += padded_rows
             self.window_shards_hist[n_shards] = \
                 self.window_shards_hist.get(n_shards, 0) + 1
+
+    def record_transform(self, shard: int, device_docs: int = 0,
+                         host_docs: int = 0, fallbacks: int = 0,
+                         batches: int = 0) -> None:
+        """One bucket's device-transform planning outcome
+        (tpu/xform.plan_tails_device stats): how many tails resolved
+        their merge positions on device vs. fell to the host tracker
+        walk — the `device_ratio` in the snapshot is the transform
+        rung's engagement signal."""
+        with self._lock:
+            self.xform_device_docs += device_docs
+            self.xform_host_docs += host_docs
+            self.xform_fallbacks += fallbacks
+            self.xform_batches += batches
+        if self.ts is not None and device_docs:
+            self.ts.inc("serve.xform_device_docs", device_docs)
 
     def observe_device_time(self, shard: int, wall_s: float,
                             device_s: float) -> None:
@@ -325,6 +354,16 @@ class ServeMetrics:
                 "shards_hist": {
                     str(k): v for k, v in
                     sorted(self.window_shards_hist.items())},
+            },
+            "transform": {
+                "device_docs": self.xform_device_docs,
+                "host_docs": self.xform_host_docs,
+                "fallbacks": self.xform_fallbacks,
+                "batches": self.xform_batches,
+                "device_ratio": round(
+                    self.xform_device_docs
+                    / max(self.xform_device_docs + self.xform_host_docs
+                          + self.xform_fallbacks, 1), 4),
             },
             "hydration": dict(self.hydration),
             "read": read_snap,

@@ -80,7 +80,9 @@ class MergeScheduler:
                  fused_opts: Optional[dict] = None,
                  flush_workers: bool = True,
                  warmup: bool = False,
-                 mesh_window: bool = False) -> None:
+                 mesh_window: bool = False,
+                 device_plan: bool = False,
+                 pallas: bool = False) -> None:
         """`resolve(doc_id) -> OpLog` is the document authority —
         DocStore.get fits directly. `sync_lock` (e.g. DocStore.lock) is
         the OPLOG guard: held around host-side oplog reads (session
@@ -98,7 +100,12 @@ class MergeScheduler:
         device dispatches per window), `pump()` assembles EVERY due
         shard's fusable tails into one mesh-sharded super-batch and
         issues a single `shard_map` program over the `docs` axis —
-        see `_flush_window`."""
+        see `_flush_window`. `device_plan=True` (fused device engine
+        only) plans tails through the device transform
+        (tpu/xform.plan_tails_device) instead of the host tracker walk;
+        `pallas=True` adds the Pallas step-kernel replay rung at the
+        top of the flush ladder (pallas → mesh → fused → per-doc →
+        host), each rung falling back to the next on failure."""
         self.resolve = resolve
         self._sync_lock = sync_lock if sync_lock is not None \
             else contextlib.nullcontext()
@@ -115,6 +122,8 @@ class MergeScheduler:
         # mesh flush windows ride on fused sessions (the super-batch is
         # assembled from FusedDocSession plan rows)
         self.mesh_window = bool(mesh_window) and self.fused
+        self.device_plan = bool(device_plan) and self.fused
+        self.pallas = bool(pallas) and self.fused
         self._mesh = None          # lazy: first window / warmup builds
         self.banks = [
             SessionBank(i, max_sessions=max_sessions_per_shard,
@@ -127,7 +136,8 @@ class MergeScheduler:
                         warmup=(warmup and i == 0),
                         flush_docs=flush_docs,
                         mesh_shards=(n_shards if self.mesh_window
-                                     else 0))
+                                     else 0),
+                        device_plan=device_plan, pallas=pallas)
             for i in range(n_shards)]
         # per-DEVICE locks: shards placed on the same chip share one;
         # unplaced shards (device=None) get their own (the default
@@ -620,27 +630,52 @@ class MergeScheduler:
                             parent=fspan.context(),
                             attrs={"docs": len(rows), "cap": cap,
                                    "max_ins": mi})
-                    try:
-                        ok, device_s, bp = mesh_fused_replay(
-                            mesh, sessions, plans)
-                        dispatches += 1
-                        mesh_docs += len(rows)
-                        padded_rows += bp
-                        dspan.end(padded_b=bp)
-                    except Exception as e:
-                        # mesh rung failed: these rows drop to the
-                        # per-shard fused rung; whatever that can't
-                        # recover falls per-doc/host in adoption
-                        if obs is not None:
-                            obs.recorder.record(
-                                "mesh_window_fallback",
-                                docs=len(rows), cap=cap,
-                                error=f"{e.__class__.__name__}: "
-                                      f"{e}"[:120])
-                        ok, device_s, calls = \
-                            self._window_mesh_fallback(rows)
-                        dispatches += calls
-                        dspan.end(outcome="fallback")
+                    ok = None
+                    if self.pallas and len(dlocks) <= 1:
+                        # top rung: the Pallas step-kernel replay.
+                        # Single-device windows only — the Pallas
+                        # program is not mesh-sharded, so a window
+                        # spanning devices goes straight to the mesh
+                        # rung. Any failure falls through with the
+                        # rows untouched (commits happen only at the
+                        # adopt_results fence inside a successful
+                        # replay).
+                        from ..tpu import flush_fuse as _ff
+                        try:
+                            ok, device_s = _ff.pallas_fused_replay(
+                                sessions, plans)
+                            dispatches += 1
+                            dspan.end(rung="pallas")
+                        except Exception as e:
+                            ok = None
+                            if obs is not None:
+                                obs.recorder.record(
+                                    "pallas_window_fallback",
+                                    docs=len(rows), cap=cap,
+                                    error=f"{e.__class__.__name__}: "
+                                          f"{e}"[:120])
+                    if ok is None:
+                        try:
+                            ok, device_s, bp = mesh_fused_replay(
+                                mesh, sessions, plans)
+                            dispatches += 1
+                            mesh_docs += len(rows)
+                            padded_rows += bp
+                            dspan.end(padded_b=bp)
+                        except Exception as e:
+                            # mesh rung failed: these rows drop to the
+                            # per-shard fused rung; whatever that can't
+                            # recover falls per-doc/host in adoption
+                            if obs is not None:
+                                obs.recorder.record(
+                                    "mesh_window_fallback",
+                                    docs=len(rows), cap=cap,
+                                    error=f"{e.__class__.__name__}: "
+                                          f"{e}"[:120])
+                            ok, device_s, calls = \
+                                self._window_mesh_fallback(rows)
+                            dispatches += calls
+                            dspan.end(outcome="fallback")
                 wall = time.perf_counter() - t_cls
                 PROFILER.observe_window(wall, device_s, len(rows),
                                         len(shards))

@@ -250,7 +250,8 @@ def cmd_serve_bench(args) -> int:
               max_sessions=args.max_sessions, seed=args.seed,
               fused=args.fused, flush_workers=args.workers,
               warmup=args.warmup, steady_rounds=args.steady_rounds,
-              mesh_window=args.mesh_window, telemetry=args.telemetry)
+              mesh_window=args.mesh_window, telemetry=args.telemetry,
+              device_plan=args.device_plan, pallas=args.pallas)
     if args.dry_run:
         # CI smoke preset: host engine, tiny workload, no jax needed
         kw.update(shards=2, docs=4, txns=6, engine="host",
@@ -804,6 +805,19 @@ def main(argv=None) -> int:
                    help="mesh flush windows: every due shard's bucket "
                    "replayed in ONE shard_map dispatch per window "
                    "(default: one device call per shard)")
+    c.add_argument("--device-plan",
+                   action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="device-resident tail transform: resolve "
+                   "concurrent merge positions on device "
+                   "(tpu/xform.py) instead of the host tracker walk; "
+                   "per-doc host fallback on any guard trip")
+    c.add_argument("--pallas",
+                   action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="Pallas step-kernel replay rung at the top of "
+                   "the flush ladder (pallas -> mesh -> fused -> "
+                   "per-doc -> host)")
     c.add_argument("--warmup", action="store_true",
                    help="pre-compile the fused jit kernels before "
                    "feeding (keeps compiles off the flush path)")

@@ -53,7 +53,8 @@ ROOT = -1
 
 def split_runs_at_anchors(ids: np.ndarray, length: np.ndarray,
                           ol: np.ndarray, orr: np.ndarray,
-                          extra: Tuple[np.ndarray, ...] = ()
+                          extra: Tuple[np.ndarray, ...] = (),
+                          extra_cuts: np.ndarray | None = None
                           ) -> Tuple[np.ndarray, ...]:
     """Split RLE runs so that every origin-left lands on a run's LAST item
     and every origin-right on a run's FIRST item. After this pass the tree
@@ -65,10 +66,19 @@ def split_runs_at_anchors(ids: np.ndarray, length: np.ndarray,
     right half keeps the SAME orr only if it was the run's trailing part;
     mid-run items' effective right origin within a run is the next item of
     the run itself, which stays adjacent — the chain ol encodes that.
+
+    `extra_cuts` adds caller-chosen item-id cut points (the device
+    transform cuts at the old/new LV threshold and at delete-target
+    boundaries so per-run visibility is all-or-nothing). Extra cuts
+    produce chained pieces exactly like anchor cuts, so they refine the
+    run granularity without changing the linearization.
     """
     ends = ids + length
     # cut points: after every referenced ol (ol+1), and at every orr
-    cuts = np.concatenate([ol[ol != ROOT] + 1, orr[orr != ROOT]])
+    cuts = np.concatenate(
+        [ol[ol != ROOT] + 1, orr[orr != ROOT]]
+        + ([np.asarray(extra_cuts, dtype=ids.dtype)]
+           if extra_cuts is not None and len(extra_cuts) else []))
     cuts = np.unique(cuts)
     # map each cut to the run containing it strictly inside (start < cut < end)
     order = np.argsort(ids, kind="stable")

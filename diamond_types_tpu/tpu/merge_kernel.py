@@ -52,35 +52,11 @@ class DeviceDoc:
     frontier: Optional[List[int]] = None  # version the checkout lands on
 
 
-def _agent_keys(oplog, lvs: np.ndarray):
-    """(name-rank, seq) per LV, vectorized over the agent-assignment runs.
-
-    Reference tie-break: agent NAME order then seq
-    (causalgraph/agent_assignment/mod.rs:163)."""
-    aa = oplog.cg.agent_assignment
-    gr = aa.global_runs
-    lv0 = np.asarray([r[0] for r in gr], dtype=np.int64)
-    ag = np.asarray([r[2] for r in gr], dtype=np.int64)
-    sq0 = np.asarray([r[3] for r in gr], dtype=np.int64)
-    o = np.argsort(lv0)
-    lv0, ag, sq0 = lv0[o], ag[o], sq0[o]
-    name_rank = np.asarray(np.argsort(np.argsort(aa.agent_names)))
-    j = np.clip(np.searchsorted(lv0, lvs, side="right") - 1, 0, len(lv0) - 1)
-    agent = np.where(lvs >= UNDERWATER, 0, name_rank[ag[j]])
-    seq = np.where(lvs >= UNDERWATER, 0, sq0[j] + (lvs - lv0[j]))
-    return agent, seq
-
-
-def _arena_offsets(oplog, lvs: np.ndarray) -> np.ndarray:
-    """Insert-arena char offset of each LV (must be insert LVs)."""
-    from ..text.op import INS
-    runs = oplog.ops.runs
-    lv0 = np.asarray([r.lv for r in runs], dtype=np.int64)
-    cp0 = np.asarray(
-        [r.content_pos[0] if (r.kind == INS and r.content_pos is not None)
-         else -1 for r in runs], dtype=np.int64)
-    j = np.clip(np.searchsorted(lv0, lvs, side="right") - 1, 0, len(lv0) - 1)
-    return cp0[j] + (lvs - lv0[j])
+# The agent-rank and insert-arena columns moved to listmerge/columnar.py
+# (shared with the device transform, tpu/xform.py); the historical names
+# stay importable — plan_kernels and the bench harnesses use them.
+from ..listmerge.columnar import (agent_key_columns as _agent_keys,
+                                  arena_offset_columns as _arena_offsets)
 
 
 def prepare_doc(oplog, from_frontier: Sequence[int] = (),

@@ -270,6 +270,80 @@ def materialize_pallas(perm, vis_len, arena_off, arena, cap: int,
     return out[0, :cap], total
 
 
+# ---------------------------------------------------------------------------
+# transform position resolution: prefix scans with a carried chunk state
+# ---------------------------------------------------------------------------
+
+_XCB = 512          # scan-chunk lanes (4 int32 vregs)
+
+
+def _xform_pos_kernel(nv_ref, ov_ref, pos_ref, stats_ref, *, cb: int):
+    """One chunk of the transform's position-resolution scan (grid =
+    chunks, sequential on TPU so the stats row carries across steps).
+
+    Given DOC-ORDERED visible-length columns (nv = chars after the
+    merge, ov = chars at the session frontier), each run's edit position
+    is the exclusive prefix sum of nv, the projected length is Σnv, and
+    the replay's peak length offset is the running max of Σ(nv-ov).
+
+    Gather-free by construction (the Mosaic ≤128-lane gather limit —
+    module doc): the caller applies the device-computed Fugue order
+    BEFORE this kernel, so everything here is chunked cumsums + a
+    carried scalar row — no per-lane table lookups at all.
+
+    stats row: [0] chars emitted so far, [1] running Σ(nv-ov),
+    [2] running peak of Σ(nv-ov)."""
+    k = pl.program_id(0)
+
+    @pl.when(k == 0)
+    def _init():
+        stats_ref[...] = jnp.zeros_like(stats_ref)
+
+    base = stats_ref[0, 0]
+    cdelta = stats_ref[0, 1]
+    peak = stats_ref[0, 2]
+    nv = nv_ref[...]                    # [1, cb]
+    ov = ov_ref[...]
+    c = jnp.cumsum(nv, axis=1)
+    pos_ref[...] = base + c - nv
+    d = jnp.cumsum(nv - ov, axis=1)
+    stats_ref[0, 0] = base + c[0, cb - 1]
+    stats_ref[0, 1] = cdelta + d[0, cb - 1]
+    stats_ref[0, 2] = jnp.maximum(peak, cdelta + jnp.max(d))
+
+
+def xform_positions_pallas(nv, ov, *, interpret: bool = False):
+    """Gather-free Pallas run of the transform position-resolution hot
+    loop (drop-in for the jnp scans in tpu/xform._xform_single; inputs
+    are the doc-order-permuted visibility columns). Returns
+    (pos [n] int32, new_len, peak_delta >= 0)."""
+    if not interpret and jax.default_backend() != "tpu":
+        interpret = True   # CPU/GPU backends run the kernel interpreted
+    n = nv.shape[0]
+    cb = min(_XCB, _round_up(max(n, 1), 128))
+    npad = _round_up(max(n, 1), cb)
+    nv_p = jnp.zeros((1, npad), jnp.int32).at[0, :n].set(
+        nv.astype(jnp.int32))
+    ov_p = jnp.zeros((1, npad), jnp.int32).at[0, :n].set(
+        ov.astype(jnp.int32))
+    tab = pl.BlockSpec((1, cb), lambda k: (0, k))
+    stat = pl.BlockSpec((1, 4), lambda k: (0, 0))
+    if not interpret and _SMEM is not None:
+        tab = pl.BlockSpec((1, cb), lambda k: (0, k), memory_space=_VMEM)
+        stat = pl.BlockSpec((1, 4), lambda k: (0, 0), memory_space=_SMEM)
+    pos, stats = pl.pallas_call(
+        functools.partial(_xform_pos_kernel, cb=cb),
+        grid=(npad // cb,),
+        in_specs=[tab, tab],
+        out_specs=[tab, stat],
+        out_shape=[jax.ShapeDtypeStruct((1, npad), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 4), jnp.int32)],
+        interpret=interpret,
+    )(nv_p, ov_p)
+    return (pos[0, :n], stats[0, 0],
+            jnp.maximum(stats[0, 2], jnp.int32(0)))
+
+
 def _next_pow2(x: int) -> int:
     return 1 << max(1, int(x) - 1).bit_length()
 

@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: xform jit-guard lock-order violation.
+
+Acquires the oplog guard (30) while already holding the transform
+jit-cache guard (`_xform_jit_lock`, device, 40) — backwards against
+the canonical order: the device transform dispatch runs OUTSIDE the
+oplog guard by design (extracts are self-contained), so planning code
+releases the oplog rung before the jit guard, never re-enters under it.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureXformPlanner:
+    def backwards(self, sessions):
+        with self._xform_jit_lock:
+            with self.store.lock:
+                return [self._resolve(s) for s in sessions]

@@ -37,7 +37,7 @@ def bank_paths(dw, tmp_path, monkeypatch):
 def test_bench_list_is_shared_with_bench_py(dw):
     import bench
     assert dw.BENCHES is bench.DEVICE_BENCHES
-    assert len(dw.BENCHES) == 9
+    assert len(dw.BENCHES) == 10
 
 
 def test_bench_of_classifies_real_phase_keys(dw):
@@ -62,6 +62,11 @@ def test_bench_of_classifies_real_phase_keys(dw):
         "tpu_session_build_ms": "tpu_session_friendsforever",
         "tpu_batched_replay_ops_per_sec": "tpu_batched_replay",
         "fanin_10k_propagation_ms": "fanin_10k",
+        "tpu_transform_git_makefile_ops_per_sec":
+            "tpu_transform_git_makefile",
+        "tpu_transform_speedup": "tpu_transform_git_makefile",
+        "tpu_transform_device_plan_ms": "tpu_transform_git_makefile",
+        "tpu_transform_host_plan_ms": "tpu_transform_git_makefile",
         # globals
         "device_platform": None,
         "tunnel_rtt_ms": None,
@@ -116,6 +121,7 @@ def test_catch_complete_requires_every_bench(dw):
             "tpu_zone_git_makefile_ops_per_sec": 1,
             "tpu_zone_friendsforever_ops_per_sec": 1,
             "tpu_session_per_merge_ms": 1,
+            "tpu_transform_git_makefile_ops_per_sec": 1,
             "tpu_batched_replay_ops_per_sec": 1,
             "fanin_10k_propagation_ms": 1}
     assert dw._catch_complete(done)
