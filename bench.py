@@ -879,6 +879,7 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
                       fused: bool = True, steady_rounds: int = 8,
                       mesh_window: bool = False,
                       telemetry: bool = True,
+                      journey: bool = True,
                       mode: str = "trace",
                       flush_docs: int = None,
                       max_sessions: int = None,
@@ -926,6 +927,8 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
         cmd.append("--warmup")
     if not telemetry:
         cmd.append("--no-telemetry")
+    if not journey:
+        cmd.append("--no-journey")
     p = subprocess.run(cmd, capture_output=True, text=True,
                        timeout=timeout,
                        cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -1593,6 +1596,22 @@ def _main() -> None:
             extra["serve_sched"]["slo_ok"] = sv.get("slo_ok")
         except Exception as e:  # pragma: no cover
             extra["serve_sched"]["telemetry_error"] = str(e)[:120]
+        # journey-stamp overhead A/B on the same trace: the edit-to-
+        # visibility tracker disabled (single-branch no-op stamps).
+        # Same <=3% throughput contract as the live-telemetry tier —
+        # `journey_overhead_ok` is the guard
+        try:
+            svj = bench_serve_sched(journey=False)
+            full["serve_sched_no_journey"] = svj
+            jbase = svj["ops_per_sec"]
+            joverhead = round(
+                1.0 - sv["ops_per_sec"] / max(jbase, 1), 4)
+            extra["serve_sched"]["no_journey_ops_per_sec"] = jbase
+            extra["serve_sched"]["journey_overhead"] = joverhead
+            extra["serve_sched"]["journey_overhead_ok"] = \
+                joverhead <= 0.03
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched"]["journey_error"] = str(e)[:120]
         # device-plan transform A/B on a CONCURRENT trace: host tracker
         # walk (control) vs. the device transform rung + Pallas replay
         # on the same schedule. A concurrent mode + resident sessions

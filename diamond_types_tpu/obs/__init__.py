@@ -11,6 +11,9 @@ One `Observability` bundle per server process ties together:
   slo.py        multi-window burn-rate SLO engine (/debug/slo)
   exemplars.py  last sampled trace id per histogram bucket
   attrib.py     top-K hot-doc/agent sketch (/debug/hot)
+  journey.py    edit-to-visibility stage stamps + convergence lag
+  assemble.py   cross-host trace assembly (clock-aligned waterfall
+                + critical path; consumed by `cli dt-trace`)
 
 The bundle is attached as `DocStore.obs` by tools/server.serve() and
 propagated from there: MergeScheduler.attach_obs() wires the tracer
@@ -26,6 +29,8 @@ from .attrib import HotAttribution, SpaceSaving
 from .devprof import PROFILER, DeviceProfiler, note_jit_lookup, note_transfer
 from .exemplars import ExemplarStore
 from .hist import BOUNDS, Histogram, HistogramSet
+from .journey import STAGES as JOURNEY_STAGES
+from .journey import OpJourney
 from .prom import CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, render_metrics
 from .recorder import FlightRecorder
 from .slo import Objective, SloEngine, default_objectives
@@ -42,6 +47,7 @@ __all__ = [
     "PROFILER", "DeviceProfiler", "note_jit_lookup", "note_transfer",
     "TimeSeries", "SloEngine", "Objective", "default_objectives",
     "ExemplarStore", "HotAttribution", "SpaceSaving",
+    "OpJourney", "JOURNEY_STAGES",
 ]
 
 
@@ -60,7 +66,9 @@ class Observability:
                  seed: int = 0, enabled: bool = True,
                  telemetry: bool = True,
                  ts_window_s: float = 10.0, ts_windows: int = 360,
-                 objectives=None, attrib_k: int = 64) -> None:
+                 objectives=None, attrib_k: int = 64,
+                 journey: bool = True,
+                 journey_capacity: int = 512) -> None:
         self.tracer = Tracer(sample_rate=sample_rate,
                              capacity=trace_capacity,
                              seed=seed, enabled=enabled)
@@ -78,6 +86,12 @@ class Observability:
                              recorder=self.recorder)
         self.exemplars = ExemplarStore(enabled=live)
         self.attrib = HotAttribution(k=attrib_k, enabled=live)
+        # edit-to-visibility journey tracker: stamps ride the sampled
+        # traces, so it follows the tracer's enablement; `journey=False`
+        # is the bench A/B control arm (single-branch no-op stamps)
+        self.journey = OpJourney(capacity=journey_capacity,
+                                 ts=self.ts if live else None,
+                                 enabled=enabled and journey)
 
     def snapshot(self) -> dict:
         out = {"trace": self.tracer.stats(),
@@ -87,7 +101,8 @@ class Observability:
                "timeseries": self.ts.snapshot(),
                "slo": self.slo.snapshot(),
                "exemplars": self.exemplars.snapshot(),
-               "hot": self.attrib.snapshot()}
+               "hot": self.attrib.snapshot(),
+               "journey": self.journey.snapshot()}
         # concurrency-invariant tier (analysis/): the runtime lock
         # witness is always reported (enabled=False when off); the
         # lint block appears once a dt-lint run published a report in

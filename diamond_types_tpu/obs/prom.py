@@ -26,6 +26,10 @@ Naming scheme:
   dt_hot_*{dim,kind[,key]}            top-K attribution (bounded: the
                                       sketch caps key cardinality)
   dt_ts_*{series}                     live windowed rates / p99
+  dt_journey_*{stage}                 edit-to-visibility stage stamps
+                                      (zero-filled over journey.STAGES)
+  dt_convergence_lag_*{peer}          per-peer admitted->advert lag
+                                      rollup (+ the peer="all" row)
 
 Each metric name is declared exactly once (# TYPE line) no matter how
 many labeled samples it carries; label values are escaped per the
@@ -47,6 +51,8 @@ Accept header.
 from __future__ import annotations
 
 from typing import Dict, List, Optional
+
+from .journey import STAGES as JOURNEY_STAGES
 
 CONTENT_TYPE = "text/plain; version=0.0.4"
 OPENMETRICS_CONTENT_TYPE = \
@@ -380,6 +386,44 @@ def _render_obs(b: _Builder, obs: dict) -> None:
             if "p99_300s" in row:
                 b.add("dt_ts_p99_seconds", "gauge", row["p99_300s"],
                       labels=lb)
+    # edit-to-visibility journey tier: zero-filled stage counters (the
+    # jit-family idiom above — every stage row exists from the first
+    # scrape) plus the per-peer convergence-lag rollup. The aggregate
+    # peer="all" row keeps the lag family present before any peer has
+    # adverted, so scrapers see a stable family set.
+    jo = obs.get("journey") or {}
+    if jo:
+        b.add("dt_journey_enabled", "gauge",
+              1 if jo.get("enabled") else 0)
+        b.add("dt_journey_tracked", "gauge", jo.get("tracked", 0))
+        b.add("dt_journey_stamps_total", "counter",
+              jo.get("stamped", 0))
+        b.add("dt_journey_dropped_total", "counter",
+              jo.get("dropped", 0))
+        stages = dict.fromkeys(JOURNEY_STAGES, 0)
+        stages.update(jo.get("stages") or {})
+        for stage in JOURNEY_STAGES:
+            b.add("dt_journey_stage_total", "counter", stages[stage],
+                  labels={"stage": stage})
+        conv = jo.get("convergence") or {}
+        all_n = sum(row.get("n", 0) for row in conv.values())
+        all_sum = sum(row.get("n", 0) * row.get("mean_s", 0.0)
+                      for row in conv.values())
+        all_max = max([row.get("max_s", 0.0)
+                       for row in conv.values()] or [0.0])
+        for peer, row in [("all", {"n": all_n,
+                                   "mean_s": all_sum / all_n
+                                   if all_n else 0.0,
+                                   "max_s": all_max})] \
+                + sorted(conv.items()):
+            lb = {"peer": peer}
+            b.add("dt_convergence_lag_count", "counter",
+                  row.get("n", 0), labels=lb)
+            b.add("dt_convergence_lag_seconds_sum", "counter",
+                  round(row.get("n", 0) * row.get("mean_s", 0.0), 6),
+                  labels=lb)
+            b.add("dt_convergence_lag_seconds_max", "gauge",
+                  row.get("max_s", 0.0), labels=lb)
     hot = obs.get("hot") or {}
     for dim in ("doc", "agent"):
         for kind, block in sorted((hot.get(dim) or {}).items()):

@@ -67,6 +67,10 @@ def default_objectives() -> List[Objective]:
                   "serve.hydration_cold_start", threshold_s=30.0),
         Objective("quorum_round_p99", "repl.quorum_round",
                   threshold_s=10.0),
+        # edit-to-visibility: fed by obs/journey.py on advert_usable
+        # stamps (admitted -> follower-advert-usable lag per peer)
+        Objective("visibility_p99", "journey.visibility",
+                  threshold_s=30.0),
     ]
 
 

@@ -22,6 +22,7 @@ recorder for traces, not an exporter; scrape via Tracer.spans().
 from __future__ import annotations
 
 import collections
+import os
 import random
 import threading
 import time
@@ -149,6 +150,15 @@ class Tracer:
         self.sample_rate = float(sample_rate)
         self._lock = threading.Lock()
         self._rng = random.Random((seed << 16) ^ 0x7ace)
+        # ids draw from a separate, per-tracer-unique stream: every
+        # server defaults to seed=0, so id'ing from the (deterministic)
+        # sampling rng would make all hosts mint IDENTICAL span-id
+        # sequences — merged cross-host traces (obs/assemble.py) would
+        # cross-link colliding ids into parent cycles
+        self._id_rng = random.Random(
+            ((seed << 16) ^ 0x7ace)
+            ^ (os.getpid() << 48) ^ id(self)
+            ^ time.monotonic_ns())
         self._spans: collections.deque = collections.deque(
             maxlen=max(int(capacity), 1))
         self.started = 0
@@ -174,9 +184,9 @@ class Tracer:
                 if not force and self._rng.random() >= self.sample_rate:
                     self.sampled_out += 1
                     return NOOP_SPAN
-                trace_id = "%016x" % self._rng.getrandbits(64)
+                trace_id = "%016x" % self._id_rng.getrandbits(64)
                 parent_id = None
-            span_id = "%016x" % self._rng.getrandbits(64)
+            span_id = "%016x" % self._id_rng.getrandbits(64)
         return Span(self, name, trace_id, span_id, parent_id,
                     dict(attrs) if attrs else {})
 
@@ -198,6 +208,32 @@ class Tracer:
 
     def find(self, trace_id: str) -> list:
         return [s for s in self.spans() if s["trace"] == trace_id]
+
+    def index(self, limit: int = 50) -> list:
+        """Recent sampled traces, newest first: trace id, root span
+        name (the earliest span without an in-ring parent), wall time
+        and span count. Backs `GET /debug/traces`."""
+        traces: dict = {}
+        order: list = []
+        for s in self.spans():
+            tid = s["trace"]
+            if tid not in traces:
+                traces[tid] = []
+                order.append(tid)
+            traces[tid].append(s)
+        out = []
+        for tid in reversed(order):
+            spans = traces[tid]
+            ids = {s["span"] for s in spans}
+            roots = [s for s in spans
+                     if not s["parent"] or s["parent"] not in ids]
+            root = min(roots or spans, key=lambda s: s["t0"])
+            out.append({"trace": tid, "root": root["name"],
+                        "t0": root["t0"], "dur_s": root["dur_s"],
+                        "spans": len(spans)})
+            if len(out) >= max(int(limit), 1):
+                break
+        return out
 
     def stats(self) -> dict:
         with self._lock:

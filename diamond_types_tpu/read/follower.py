@@ -71,6 +71,10 @@ class FollowerIndex:
         self._read_lock = make_lock("read.follower", "io")
         self._docs: Dict[str, _DocEvidence] = {}
         self.metrics = metrics
+        # obs.journey.OpJourney hook (wired by ReplicaNode when the
+        # server carries an obs bundle): a peer's advert is the final
+        # edit-to-visibility stage — a follower read can be served
+        self.journey = None
 
     # ---- evidence feed ---------------------------------------------------
 
@@ -90,6 +94,12 @@ class FollowerIndex:
                 ev.adverts[peer_id] = (fr, t)
         if self.metrics is not None:
             self.metrics.bump("adverts")
+        j = self.journey
+        if j is not None:
+            # journey closes here: the advert proves the peer reached a
+            # frontier at `t` — guarded inside the tracker so it only
+            # lands after `applied_at_peer` from the same peer
+            j.stamp_doc(doc_id, "advert_usable", peer=peer_id, t=t)
 
     def note_reconciled(self, doc_id: str, peer_id: str,
                         as_of: Optional[float] = None) -> None:

@@ -175,12 +175,40 @@ class AntiEntropy:
             # dominates the peer's as of t0
             reads.index.note_reconciled(doc_id, peer_id, as_of=t0)
         if push_patch is not None:
-            node.table.call(peer_id, f"/doc/{doc_id}/push",
-                            data=push_patch)
+            from ..obs.trace import NOOP_SPAN, TRACE_HEADER
+            obs = getattr(node, "obs", None)
+            span = NOOP_SPAN
+            # X-DT-Replication marks the patch as host-targeted
+            # anti-entropy traffic: the peer applies it locally instead
+            # of routing it through the mutation proxy (which would
+            # bounce an owner-pushed patch straight back to the owner,
+            # a 200 no-op that converges nothing)
+            hdrs = {"X-DT-Replication": "1"}
+            if obs is not None:
+                span = obs.tracer.start(
+                    "repl.ae_push", attrs={"peer": peer_id,
+                                           "doc": doc_id,
+                                           "bytes": len(push_patch)})
+                if span.sampled:
+                    hdrs[TRACE_HEADER] = span.header()
+            t_push = time.monotonic()
+            st, _body = node.table.call(peer_id, f"/doc/{doc_id}/push",
+                                        data=push_patch, headers=hdrs)
+            node.metrics.observe_latency("ae_ship",
+                                         time.monotonic() - t_push)
+            span.end(status=st)
             node.metrics.bump("antientropy", "docs_pushed")
             node.metrics.bump("antientropy", "bytes_pushed",
                               len(push_patch))
             out["pushed"] = 1
+            if obs is not None and st == 200:
+                # journey (owner-side bookkeeping of peer facts): the
+                # patch left this host AND the peer acknowledged
+                # applying it — one round trip observes both stages
+                obs.journey.stamp_doc(doc_id, "ae_shipped",
+                                      peer=peer_id, t=t_push)
+                obs.journey.stamp_doc(doc_id, "applied_at_peer",
+                                      peer=peer_id)
         return out
 
     # ---- background loop -------------------------------------------------

@@ -6,6 +6,10 @@ snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
 Changelog:
+  v6  `ae_ship` latency histogram — per-peer anti-entropy push round
+      trip (encode→200), the owner-side half of the edit-to-visibility
+      journey (obs/journey.py stamps ae_shipped/applied_at_peer off
+      the same call).
   v5  elastic-mesh rebalancer: new `rebalance` group (overrides
       set/cleared/merged, migrations started/completed/aborted, and
       `override_table_size` injected by the node at snapshot time),
@@ -54,7 +58,7 @@ Schema (snapshot()):
                   "deaths"},
    "latencies": {"handoff": hist, "quorum_round": hist,
                  "probe": hist, "antientropy_round": hist,
-                 "rebalance_drain": hist},
+                 "rebalance_drain": hist, "ae_ship": hist},
    "per_peer": {peer_id: {"consecutive_failures", "circuit_open",
                           "backoff_s", "last_ok_age_s"}},
    "membership_view": {"view_version", "members": {...}} | null,
@@ -70,7 +74,7 @@ from typing import Dict
 from ..obs.hist import Histogram
 
 _LATENCY_NAMES = ("handoff", "quorum_round", "probe",
-                  "antientropy_round", "rebalance_drain")
+                  "antientropy_round", "rebalance_drain", "ae_ship")
 
 _GROUPS = {
     "leases": ("acquires", "renewals", "takeovers", "releases",
@@ -97,9 +101,8 @@ _GROUPS = {
 
 
 class ReplicationMetrics:
-    # v4 -> v5: rebalance group + adverts_relayed + rebalance_drain
-    # histogram (see changelog)
-    SCHEMA_VERSION = 5
+    # v5 -> v6: ae_ship latency histogram (see changelog)
+    SCHEMA_VERSION = 6
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id

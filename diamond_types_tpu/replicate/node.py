@@ -127,6 +127,11 @@ class ReplicaNode:
             # live telemetry: replication counters + quorum/handoff
             # latencies double-write into the windowed TimeSeries
             self.metrics.ts = getattr(obs, "ts", None)
+            # journey: a peer's frontier advert closing the loop on a
+            # tracked edit stamps `advert_usable` (read/follower.py)
+            reads = getattr(store, "reads", None)
+            if reads is not None:
+                reads.index.journey = getattr(obs, "journey", None)
         # ---- crash-restart restore ----
         self.journal: Optional[ReplicaJournal] = None
         self.rejoining = False

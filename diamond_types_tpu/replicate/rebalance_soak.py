@@ -90,7 +90,9 @@ def run_rebalance_soak(servers: int = 3, docs: int = 8, seed: int = 7,
 
     rng = random.Random(seed)
     doc_ids = [f"elastic-{i}" for i in range(docs)]
-    obs_opts = dict(sample_rate=0.0, ts_window_s=0.5, ts_windows=64,
+    # sample_rate=1.0 so every edit carries a journey — the verdict's
+    # convergence-lag column needs advert_usable stamps to aggregate
+    obs_opts = dict(sample_rate=1.0, ts_window_s=0.5, ts_windows=64,
                     objectives=[_objective(fast_window_s,
                                            slow_window_s)])
     node_opts = dict(seed=seed, lease_ttl_s=lease_ttl_s,
@@ -374,6 +376,12 @@ def run_rebalance_soak(servers: int = 3, docs: int = 8, seed: int = 7,
         "zero_split_brain": not split_brain,
         "wall_s": round(time.monotonic() - t0, 3),
         "metrics": {n.self_id: n.metrics_json() for n in nodes},
+        # edit-to-visibility per peer (admitted -> advert_usable); a
+        # migration that stalls replication shows up here even when
+        # the lease counters look healthy
+        "convergence_lag": {
+            n.self_id: n.obs.journey.lag_summary()
+            for n in nodes if getattr(n, "obs", None) is not None},
         "ok": ok,
     }
     if not ok:

@@ -127,8 +127,13 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
 
     def boot(i: int, port: int = 0, join_to: Optional[str] = None):
         """Boot (or reboot) server slot `i` and attach its replica."""
+        # sample_rate=1.0: every soak edit gets a trace AND a journey.
+        # follower_reads gives each owner a FollowerIndex, whose advert
+        # hook closes journeys at advert_usable — without it the
+        # verdict's convergence-lag column exists but never populates.
         httpd = serve(port=port, serve_shards=serve_shards,
-                      data_dir=dirs[i])
+                      data_dir=dirs[i], follower_reads=True,
+                      obs_opts=dict(sample_rate=1.0))
         addr = f"127.0.0.1:{httpd.server_address[1]}"
         opts = dict(node_opts)
         if dirs[i] is not None:
@@ -145,7 +150,8 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
     for i in range(servers):
         dirs.append(_dir(i))
         httpd = serve(port=0, serve_shards=serve_shards,
-                      data_dir=dirs[i])
+                      data_dir=dirs[i], follower_reads=True,
+                      obs_opts=dict(sample_rate=1.0))
         httpds.append(httpd)
         addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
         live.append(True)
@@ -350,6 +356,11 @@ def run_replicate_soak(servers: int = 3, docs: int = 4, rounds: int = 20,
         "faults": faults.snapshot(),
         "wall_s": round(time.monotonic() - t0, 3),
         "metrics": {n.self_id: n.metrics_json() for n in live_nodes},
+        # edit-to-visibility: per-peer convergence-lag rollup of every
+        # journey each owner tracked (admitted -> advert_usable)
+        "convergence_lag": {
+            n.self_id: n.obs.journey.lag_summary()
+            for n in live_nodes if getattr(n, "obs", None) is not None},
     }
     if use_witness:
         # the observed lock-order graph across every thread the soak

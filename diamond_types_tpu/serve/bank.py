@@ -148,6 +148,9 @@ class SessionBank:
         # obs.recorder.FlightRecorder (MergeScheduler.attach_obs);
         # evictions and fallbacks are rare enough to record each one
         self.recorder = None
+        # obs.journey.OpJourney (same attach path): planned /
+        # device_replayed / adopted stamps for sampled-trace items
+        self.journey = None
         # residency tier (MergeScheduler.attach_hydrator): called as
         # snapshot_hook(doc_id, pending_ops) at every eviction site so
         # pending device state is persisted instead of silently
@@ -380,8 +383,23 @@ class SessionBank:
         if self.fused and self.engine == "device":
             serial, groups = self._plan_fused(items, ols, olock,
                                               min_fuse=min_fuse)
+        self._journey_stamp(items, "planned")
         return {"items": items, "ols": ols, "serial": serial,
                 "groups": groups}
+
+    def _journey_stamp(self, items, stage: str, docs=None) -> None:
+        """Journey stamps for sampled-trace items; `docs` narrows to a
+        doc-id subset. No-op until attach_obs wires `self.journey`."""
+        j = self.journey
+        if j is None or not j.enabled:
+            return
+        for it in items:
+            tr = getattr(it, "trace", None)
+            if tr is None or not tr.sampled:
+                continue
+            if docs is not None and it.doc_id not in docs:
+                continue
+            j.stamp(tr.trace_id, stage)
 
     def adopt_window(self, win: dict, failed: List[str],
                      oplog_lock=None, device_lock=None) -> dict:
@@ -429,6 +447,10 @@ class SessionBank:
             if self.metrics is not None:
                 self.metrics.observe_footprint(self.shard_id,
                                                self.footprint_slots())
+        # journey: every surviving item is merged once adoption ends —
+        # fused rows committed at the device fence, serial/failed rows
+        # through the fallback ladder just now
+        self._journey_stamp(win["items"], "adopted")
         return out
 
     def sync_docs(self, items, resolve,
@@ -477,6 +499,10 @@ class SessionBank:
             PROFILER.observe_fused(self.shard_id, wall, device_s, n)
             failed.extend(d for good, d in zip(ok, doc_ids)
                           if not good)
+        if fused_docs:
+            fused = {d for _s, _p, ds in win["groups"] for d in ds}
+            self._journey_stamp(items, "device_replayed",
+                                docs=fused.difference(failed))
         # ---- host phase: per-doc fallbacks + poisoned-result cleanup
         out = self.adopt_window(win, failed, oplog_lock=oplog_lock,
                                 device_lock=device_lock)

@@ -128,6 +128,7 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     steady_rounds: int = 0,
                     mesh_window: bool = False,
                     telemetry: bool = True,
+                    journey: bool = True,
                     device_plan: bool = False,
                     pallas: bool = False) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
@@ -188,7 +189,7 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         mesh_window=mesh_window, device_plan=device_plan,
         pallas=pallas)
     obs = Observability(sample_rate=obs_sample_rate, seed=seed,
-                        telemetry=telemetry)
+                        telemetry=telemetry, journey=journey)
     sched.attach_obs(obs)
     if warmup:
         # the bench should measure warm-cache flushes, not count the
@@ -285,7 +286,7 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                    "mesh_window": sched.mesh_window,
                    "device_plan": sched.device_plan,
                    "pallas": sched.pallas,
-                   "telemetry": telemetry},
+                   "telemetry": telemetry, "journey": journey},
         "total_ops": total_ops,
         "submit_retries": retries,
         "feed_wall_s": round(feed_wall, 3),
@@ -308,7 +309,8 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         "metrics": m,
         "devprof": PROFILER.snapshot(),
         "obs": {"trace": obs.tracer.stats(),
-                "ts_recorded": obs.ts.recorded},
+                "ts_recorded": obs.ts.recorded,
+                "journey": obs.journey.snapshot()},
     }
     PROFILER.enabled = False
     if mismatches:

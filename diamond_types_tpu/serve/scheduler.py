@@ -202,6 +202,7 @@ class MergeScheduler:
         self.metrics.ts = getattr(obs, "ts", None)
         for bank in self.banks:
             bank.recorder = obs.recorder
+            bank.journey = getattr(obs, "journey", None)
         if self.hydrator is not None:
             self.hydrator.recorder = obs.recorder
             self.hydrator.attrib = getattr(obs, "attrib", None)
@@ -296,6 +297,13 @@ class MergeScheduler:
                 self.metrics.bump(shard, "coalesced")
             self.metrics.observe_queue(shard, self.queue.depth(shard))
         span.end(outcome="queued", shard=shard, bucket=bucket)
+        if obs is not None and span.sampled:
+            # journey: open at the scheduler when the HTTP handler did
+            # not (driver-driven submits) — begin() is first-wins, so
+            # an ingress-admitted journey keeps its (agent, seq)
+            j = obs.journey
+            j.begin(None, None, doc=doc_id, trace=span.trace_id)
+            j.stamp(span.trace_id, "queued")
         return {"accepted": True, "shard": shard, "bucket": bucket}
 
     # ---- flush -----------------------------------------------------------
@@ -617,6 +625,7 @@ class MergeScheduler:
                       and not seen.add(id(lk))]
             dispatches = mesh_docs = padded_rows = 0
             failed: List[List[str]] = [[] for _ in entries]
+            replayed: List[set] = [set() for _ in entries]
             for (cap, mi), rows in sorted(classes.items()):
                 sessions = [r[2] for r in rows]
                 plans = [r[3] for r in rows]
@@ -680,8 +689,22 @@ class MergeScheduler:
                 PROFILER.observe_window(wall, device_s, len(rows),
                                         len(shards))
                 for good, (ei, _s, _sess, _plan, d) in zip(ok, rows):
-                    if not good:
+                    if good:
+                        replayed[ei].add(d)
+                    else:
                         failed[ei].append(d)
+            # journey: the window path orchestrates the device phase
+            # itself, so the device_replayed stamp lives here (the
+            # per-shard path stamps inside bank.sync_docs); planned /
+            # adopted ride plan_window / adopt_window for both paths
+            if obs is not None:
+                j = obs.journey
+                for ei, (_s, _r, its) in enumerate(entries):
+                    for it in its:
+                        if (it.trace is not None and it.trace.sampled
+                                and it.doc_id in replayed[ei]):
+                            j.stamp(it.trace.trace_id,
+                                    "device_replayed")
             # adoption + per-bucket flush accounting, per shard
             for ei, (s, reason, items) in enumerate(entries):
                 self.banks[s].adopt_window(

@@ -251,6 +251,7 @@ def cmd_serve_bench(args) -> int:
               fused=args.fused, flush_workers=args.workers,
               warmup=args.warmup, steady_rounds=args.steady_rounds,
               mesh_window=args.mesh_window, telemetry=args.telemetry,
+              journey=args.journey,
               device_plan=args.device_plan, pallas=args.pallas)
     if args.dry_run:
         # CI smoke preset: host engine, tiny workload, no jax needed
@@ -675,7 +676,11 @@ def cmd_obs_watch(args) -> int:
             print(json.dumps({"slo": slo, "hot": hot,
                               "events": tail,
                               "timeseries": (doc.get("obs") or {})
-                              .get("timeseries")}))
+                              .get("timeseries"),
+                              "journey": (doc.get("obs") or {})
+                              .get("journey"),
+                              "devprof": (doc.get("obs") or {})
+                              .get("devprof")}))
         else:
             ts = (doc.get("obs") or {}).get("timeseries") or {}
             print(f"== obs-watch round {rounds_done + 1} "
@@ -702,6 +707,38 @@ def cmd_obs_watch(args) -> int:
                     continue
                 row = " ".join(f"{k}={c:.0f}" for k, c, _e in tops)
                 print(f"  {kind:<14s} {row}")
+            jo = (doc.get("obs") or {}).get("journey") or {}
+            if jo.get("enabled"):
+                print(f"== convergence (tracked={jo.get('tracked', 0)} "
+                      f"dropped={jo.get('dropped', 0)}) ==")
+                stages = jo.get("stages") or {}
+                print("  " + " ".join(f"{s}={c}"
+                                      for s, c in stages.items()))
+                for peer, row in sorted(
+                        (jo.get("convergence") or {}).items()):
+                    print(f"  lag {peer:<22s} n={row.get('n', 0):<6d} "
+                          f"mean={row.get('mean_s', 0) * 1e3:8.2f}ms "
+                          f"max={row.get('max_s', 0) * 1e3:8.2f}ms")
+            dp = (doc.get("obs") or {}).get("devprof") or {}
+            jit = dp.get("jit_cache") or {}
+            if dp.get("enabled") and jit:
+                # one row per jit family — the PR-13 device-resident
+                # tail transform (`xform`) and Pallas replay rung
+                # (`pallas`) surface here next to micro/tip/fused
+                print("== device (jit cache) ==")
+                for fam, row in sorted(jit.items()):
+                    h, m = row.get("hits", 0), row.get("misses", 0)
+                    rate = h / (h + m) if (h + m) else 0.0
+                    print(f"  {fam:<14s} hits={h:<8d} misses={m:<6d} "
+                          f"hit_rate={rate:6.3f}")
+                fused = dp.get("fused") or {}
+                win = dp.get("mesh_window") or {}
+                print(f"  fused calls={fused.get('device_calls', 0)} "
+                      f"occ={fused.get('occupancy', 0)} "
+                      f"dev_frac={fused.get('device_fraction', 0)}; "
+                      f"window dispatches={win.get('dispatches', 0)} "
+                      f"docs/dispatch="
+                      f"{win.get('docs_per_dispatch', 0)}")
             print(f"== events (+{len(tail)} new, cursor {since}) ==")
             for ev in tail[-args.events:]:
                 rest = {k: v for k, v in ev.items()
@@ -719,6 +756,87 @@ def cmd_obs_watch(args) -> int:
             time.sleep(args.interval)
         except KeyboardInterrupt:
             return rc
+
+
+def cmd_dt_trace(args) -> int:
+    """Assemble one (or more) cross-host traces: fan out over
+    ``--peers``, fetch each host's local spans for the trace id
+    (GET /debug/trace/<id>), estimate per-host clock offsets from the
+    request round trip, and merge everything into a single waterfall
+    + critical path (obs/assemble.py). With no trace ids, list the
+    primary host's recent sampled traces (GET /debug/traces)."""
+    import urllib.request
+    from ..obs.assemble import aggregate, assemble_trace, render_human
+    hosts = [args.url] + [h for h in
+                          (args.peers.split(",") if args.peers else [])
+                          if h.strip()]
+    bases = []
+    for h in hosts:
+        h = h.strip().rstrip("/")
+        if "://" not in h:
+            h = "http://" + h
+        if h not in bases:
+            bases.append(h)
+
+    def _get(base, path):
+        t_send = time.monotonic()
+        with urllib.request.urlopen(base + path,
+                                    timeout=args.timeout) as r:
+            body = json.loads(r.read())
+        return body, t_send, time.monotonic()
+
+    if not args.trace_ids:
+        try:
+            body, _ts, _tr = _get(bases[0], "/debug/traces")
+        except (OSError, ValueError) as e:
+            print(f"dt-trace: index fetch failed: {e}", file=sys.stderr)
+            return 1
+        rows = body.get("traces") or []
+        if args.json:
+            print(json.dumps(body))
+        else:
+            print(f"== recent traces on {body.get('host', bases[0])} "
+                  f"({len(rows)}) ==")
+            for row in rows:
+                print(f"  {row.get('trace', '?'):<18s} "
+                      f"{row.get('root', '?'):<24s} "
+                      f"{(row.get('dur_s') or 0) * 1e3:9.2f}ms "
+                      f"spans={row.get('spans', 0)}")
+        return 0
+
+    reports = []
+    rc = 0
+    for tid in args.trace_ids:
+        fetches = []
+        for base in bases:
+            try:
+                body, t_send, t_recv = _get(base,
+                                            f"/debug/trace/{tid}")
+            except (OSError, ValueError) as e:
+                # a down peer degrades the assembly (its spans go
+                # missing / orphaned), it must not kill the command
+                print(f"dt-trace: {base} fetch failed: {e}",
+                      file=sys.stderr)
+                continue
+            fetches.append({"host": body.get("host", base),
+                            "now": body.get("now"),
+                            "spans": body.get("spans") or [],
+                            "t_send": t_send, "t_recv": t_recv})
+        rep = assemble_trace(tid, fetches)
+        reports.append(rep)
+        if rep.get("root") is None:
+            rc = 1
+    agg = aggregate(reports) if len(reports) > 1 else None
+    if args.json:
+        out = {"traces": reports}
+        if agg is not None:
+            out["aggregate"] = agg
+        print(json.dumps(out))
+    else:
+        for i, rep in enumerate(reports):
+            print(render_human(rep, agg if i == len(reports) - 1
+                               else None))
+    return rc
 
 
 def main(argv=None) -> int:
@@ -831,6 +949,12 @@ def main(argv=None) -> int:
                    help="live windowed telemetry + SLO burn-rate "
                    "engine (--no-telemetry = the overhead-A/B "
                    "control arm; SLO verdict then trivially passes)")
+    c.add_argument("--journey",
+                   action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="edit-to-visibility journey stamps "
+                   "(obs/journey.py; --no-journey = the overhead-A/B "
+                   "control arm)")
     c.add_argument("--parity", action="store_true",
                    help="explicit parity gate (parity is always "
                    "checked; this just documents the intent in CI "
@@ -1056,6 +1180,23 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true",
                    help="one JSON line per round instead")
     c.set_defaults(fn=cmd_obs_watch)
+
+    c = sub.add_parser(
+        "dt-trace",
+        help="cross-host trace assembly: fetch one trace's spans from "
+        "every peer, align clocks off the request RTT, and print the "
+        "merged waterfall + critical path")
+    c.add_argument("url", help="primary server base URL")
+    c.add_argument("trace_ids", nargs="*",
+                   help="trace ids to assemble (none: list the "
+                   "primary host's recent sampled traces)")
+    c.add_argument("--peers", default="",
+                   help="comma-separated peer base URLs to include "
+                   "in the fan-out")
+    c.add_argument("--timeout", type=float, default=5.0)
+    c.add_argument("--json", action="store_true",
+                   help="print the assembled report(s) as JSON")
+    c.set_defaults(fn=cmd_dt_trace)
 
     args = p.parse_args(argv)
     return args.fn(args)

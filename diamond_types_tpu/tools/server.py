@@ -332,6 +332,9 @@ class DocStore:
                     # pass and bring back the per-pass log spam)
                     with self.lock:
                         self.flush_failures.pop(doc_id, None)
+                    if self.obs is not None:
+                        self.obs.journey.stamp_doc(doc_id,
+                                                   "wal_durable")
                 except OSError:
                     with self.lock:
                         self._note_flush_failure(doc_id, now, "write")
@@ -639,6 +642,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                 "changes", "ops", "history", "at", "text") else "other")
         if head in ("replicate", "debug") and len(parts) == 2:
             return f"{head}_{parts[1]}"
+        if head == "debug" and len(parts) == 3 and parts[1] == "trace":
+            return "debug_trace"   # trace ids must not mint series
         if head in ("metrics", "edit", "vis", "crdt"):
             return head
         return "other"
@@ -743,6 +748,30 @@ class SyncHandler(BaseHTTPRequestHandler):
                     200,
                     json.dumps(obs.attrib.snapshot()).encode("utf8"),
                     extra=no_store)
+            if obs is not None and parts[1:2] == ["trace"] \
+                    and len(parts) == 3:
+                # local spans of one trace, plus this host's monotonic
+                # "now" — `cli dt-trace` pairs it with its own
+                # send/recv timestamps to estimate the clock offset
+                # (obs/assemble.py) before merging peers' spans
+                node = self.store.replica
+                host = node.self_id if node is not None else "local"
+                out = {"host": host, "trace": parts[2],
+                       "now": round(time.monotonic(), 6),
+                       "spans": obs.tracer.find(parts[2])}
+                return self._send(200, json.dumps(out).encode("utf8"),
+                                  extra=no_store)
+            if obs is not None and len(parts) == 2 \
+                    and parts[1] == "traces":
+                # recent sampled trace index (newest first): the entry
+                # point for picking a trace id to assemble
+                node = self.store.replica
+                host = node.self_id if node is not None else "local"
+                out = {"host": host,
+                       "now": round(time.monotonic(), 6),
+                       "traces": obs.tracer.index()}
+                return self._send(200, json.dumps(out).encode("utf8"),
+                                  extra=no_store)
             return self._send(404, b"{}")
         if parts and parts[0] == "replicate":
             node = self.store.replica
@@ -934,7 +963,13 @@ class SyncHandler(BaseHTTPRequestHandler):
             # accept — the edit is durable here, the merge gate keeps
             # device work off this host, anti-entropy reconciles.
             target = node.route_mutation(doc_id)
-            if target != node.self_id:
+            if target != node.self_id \
+                    and self.headers.get("X-DT-Replication") is None:
+                # X-DT-Replication = host-targeted anti-entropy patch:
+                # the sender chose THIS host deliberately (usually it
+                # IS the owner pushing down to a follower), so routing
+                # it back through the ownership proxy would return it
+                # to the sender as a 200 no-op. Apply locally instead.
                 if self.headers.get("X-DT-Proxied") is not None:
                     node.metrics.bump("proxy", "loops_refused")
                 else:
@@ -988,8 +1023,20 @@ class SyncHandler(BaseHTTPRequestHandler):
             if self.store.reads is not None:
                 self.store.reads.on_local_mutation(doc_id)
             if n_new:
-                self.store.submit_merge(doc_id, n_new,
-                                        trace=self._trace_ctx())
+                tctx = self._trace_ctx()
+                if obs is not None and tctx is not None \
+                        and self.headers.get("X-DT-Replication") is None:
+                    # journey opens at ingress (before submit_merge:
+                    # begin is first-wins, the handler owns identity);
+                    # binary patches carry agent names but no single
+                    # (agent, seq), so identity is the first new agent.
+                    # Anti-entropy patches are excluded: those edits'
+                    # journeys live on their owner, not here.
+                    agents = _patch_agent_names(body)
+                    obs.journey.begin(agents[0] if agents else None,
+                                      None, doc=doc_id,
+                                      trace=tctx.trace_id)
+                self.store.submit_merge(doc_id, n_new, trace=tctx)
             return self._send(200, json.dumps(
                 {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
@@ -1046,8 +1093,15 @@ class SyncHandler(BaseHTTPRequestHandler):
             self.store.notify(doc_id)
             if self.store.reads is not None:
                 self.store.reads.on_local_mutation(doc_id)
-            self.store.submit_merge(doc_id, len(ops),
-                                    trace=self._trace_ctx())
+            tctx = self._trace_ctx()
+            if obs is not None and tctx is not None:
+                # journey identity = the edit's (agent, last seq): the
+                # post-apply remote frontier carries the agent's head
+                seq = next((s for a, s in out if a == req["agent"]),
+                           None)
+                obs.journey.begin(req["agent"], seq, doc=doc_id,
+                                  trace=tctx.trace_id)
+            self.store.submit_merge(doc_id, len(ops), trace=tctx)
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
         if action == "changes":
@@ -1116,8 +1170,14 @@ class SyncHandler(BaseHTTPRequestHandler):
                     self.store.notify(doc_id)
                     if self.store.reads is not None:
                         self.store.reads.on_local_mutation(doc_id)
+                    tctx = self._trace_ctx()
+                    if obs is not None and tctx is not None:
+                        op0 = (req.get("push") or [{}])[0]
+                        obs.journey.begin(op0.get("agent"),
+                                          op0.get("seq"), doc=doc_id,
+                                          trace=tctx.trace_id)
                     self.store.submit_merge(doc_id, applied,
-                                            trace=self._trace_ctx())
+                                            trace=tctx)
                     if obs is not None:
                         for op in req.get("push") or []:
                             a = op.get("agent")
