@@ -1671,6 +1671,31 @@ def _main() -> None:
     except Exception as e:  # pragma: no cover
         extra["serve_read_error"] = str(e)[:120]
 
+    # Scenario harness (workload/ tier): the tier-1 smoke scenario —
+    # mixed-tenant Poisson/Zipf traffic + session churn + bulk + bank
+    # lanes against two replicated servers with the SLO engine live.
+    # The full scorecard goes in the full report; the summary keeps
+    # the one-diff regression signals (scorecard-diff gates on these)
+    try:
+        from diamond_types_tpu.workload import get_scenario, run_scenario
+        card = run_scenario(get_scenario("smoke"))
+        full["scenario_smoke"] = card
+        extra["scenario_smoke"] = {
+            "ok": card["ok"],
+            "ops_per_sec": card["throughput"]["ops_per_s"],
+            "flush_p99_s": card["latency_p99_s"]["flush"],
+            "read_p99_s": card["latency_p99_s"]["read"],
+            "visibility_p99_s": card["latency_p99_s"]["visibility"],
+            "burn_minutes": card["burn_minutes_total"],
+            "bytes_per_op": card["bytes_per_op"],
+            "converged": card["convergence"]["converged"],
+            "spills_to_snapshot":
+                card["hydration"].get("spills_to_snapshot"),
+            "spill_bytes": card["hydration"].get("spill_bytes"),
+        }
+    except Exception as e:  # pragma: no cover
+        extra["scenario_smoke_error"] = str(e)[:120]
+
     # Peak-memory probe (reference: examples/posstats.rs behind the
     # memusage feature / trace-alloc counting allocator). Python-side
     # allocations only; the C++ tier's tables are outside tracemalloc.

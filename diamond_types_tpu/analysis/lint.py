@@ -49,7 +49,7 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
 DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
-                    "storage", "read", "obs")
+                    "storage", "read", "obs", "workload")
 
 SEVERITY = {
     "lock-order": "error",

@@ -14,6 +14,8 @@ One `Observability` bundle per server process ties together:
   journey.py    edit-to-visibility stage stamps + convergence lag
   assemble.py   cross-host trace assembly (clock-aligned waterfall
                 + critical path; consumed by `cli dt-trace`)
+  scorecard.py  versioned per-scenario scorecards + tolerance-band
+                diffs (consumed by `cli scenario` / `scorecard-diff`)
 
 The bundle is attached as `DocStore.obs` by tools/server.serve() and
 propagated from there: MergeScheduler.attach_obs() wires the tracer
@@ -33,6 +35,9 @@ from .journey import STAGES as JOURNEY_STAGES
 from .journey import OpJourney
 from .prom import CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, render_metrics
 from .recorder import FlightRecorder
+from .scorecard import (SCORECARD_VERSION, build_scorecard,
+                        diff_scorecards, last_scenario,
+                        publish_scenario)
 from .slo import Objective, SloEngine, default_objectives
 from .timeseries import TimeSeries
 from .trace import (NOOP_SPAN, TRACE_HEADER, Span, SpanContext, Tracer,
@@ -48,6 +53,8 @@ __all__ = [
     "TimeSeries", "SloEngine", "Objective", "default_objectives",
     "ExemplarStore", "HotAttribution", "SpaceSaving",
     "OpJourney", "JOURNEY_STAGES",
+    "SCORECARD_VERSION", "build_scorecard", "diff_scorecards",
+    "publish_scenario", "last_scenario",
 ]
 
 
@@ -118,4 +125,10 @@ class Observability:
         explore = explore_report()
         if explore is not None:
             out["explore"] = explore
+        # the scenario runner's live snapshot (workload/runner.py
+        # publishes each tick): present while/after a run in this
+        # process — obs-watch renders it as the scenario panel
+        scen = last_scenario()
+        if scen is not None:
+            out["scenario"] = scen
         return out

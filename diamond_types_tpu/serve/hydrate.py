@@ -38,6 +38,7 @@ nests inside those (the documented io -> oplog order).
 from __future__ import annotations
 
 import contextlib
+import os
 import queue as _queue
 import threading
 import time
@@ -461,6 +462,7 @@ class Hydrator:
             self._evicting.add(doc_id)
         saved = quarantined = False
         saved_len = -1
+        size_before = self._home_size(doc_id)
         try:
             saved_len = self.store.save(doc_id, ol,
                                         oplog_lock=self.oplog_lock)
@@ -501,9 +503,28 @@ class Hydrator:
             self._bump("eviction_aborts")
             return False
         self._bump("evictions_to_snapshot")
+        if saved:
+            self._record_spill(doc_id, size_before)
         self._record("evicted_to_snapshot", doc=doc_id, why=why,
                      saved=saved)
         return True
+
+    def _home_size(self, doc_id: str) -> int:
+        """On-disk size of the doc's durable home (0 when absent) —
+        the before/after probe spill-byte accounting is built on."""
+        try:
+            return os.path.getsize(self.store.path(doc_id))
+        except OSError:
+            return 0
+
+    def _record_spill(self, doc_id: str, size_before: int) -> None:
+        """One device-tier spill: warm state persisted to the snapshot
+        home under bank/warm-map pressure. Bytes are the home file's
+        growth, clamped at 0 (compaction can shrink the home)."""
+        self._bump("spills_to_snapshot")
+        grew = self._home_size(doc_id) - size_before
+        if grew > 0:
+            self._bump("spill_bytes", grew)
 
     # ---- bank snapshot hook (SessionBank.snapshot_hook) ------------------
 
@@ -535,9 +556,11 @@ class Hydrator:
             ol = self._warm.get(doc_id)
         if ol is None:
             return      # not warm here: nothing newer than the home
+        size_before = self._home_size(doc_id)
         try:
             self.store.save(doc_id, ol, oplog_lock=self.oplog_lock)
             self._bump("snapshots")
+            self._record_spill(doc_id, size_before)
         except DocQuarantined:
             pass
         except Exception as e:

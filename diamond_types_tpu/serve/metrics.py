@@ -83,6 +83,12 @@ HYDRATION_KEYS = (
     "snapshot_errors",      # persist failed (doc stays warm)
     "evictions_to_snapshot",  # warm evictions that saved first
     "eviction_aborts",      # eviction raced a resolve; doc kept warm
+    "spills_to_snapshot",   # device-tier spills: warm state persisted
+                            # to the snapshot home under bank/warm-map
+                            # pressure (eviction + bank-evict persists)
+    "spill_bytes",          # on-disk bytes those spills wrote (home
+                            # file growth, clamped at 0 per spill —
+                            # compaction can shrink the home)
 )
 
 
@@ -111,8 +117,11 @@ class ServeMetrics:
     # tpu/xform.py: docs planned on device vs. the host tracker walk,
     # per-doc cross-check fallbacks, batched dispatches) + the
     # `pallas_fallbacks` shard counter (Pallas replay rung failures
-    # that fell to the XLA fused rung)
-    SCHEMA_VERSION = 10
+    # that fell to the XLA fused rung);
+    # v11 = device-tier spill accounting (`spills_to_snapshot` /
+    # `spill_bytes` in the hydration block — scenario scorecards stamp
+    # these; prom exports them as dt_serve_hydration_spill*_total)
+    SCHEMA_VERSION = 11
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:

@@ -680,11 +680,26 @@ def cmd_obs_watch(args) -> int:
                               "journey": (doc.get("obs") or {})
                               .get("journey"),
                               "devprof": (doc.get("obs") or {})
-                              .get("devprof")}))
+                              .get("devprof"),
+                              "scenario": (doc.get("obs") or {})
+                              .get("scenario")}))
         else:
             ts = (doc.get("obs") or {}).get("timeseries") or {}
             print(f"== obs-watch round {rounds_done + 1} "
                   f"(recorded={ts.get('recorded', 0)}) ==")
+            scen = (doc.get("obs") or {}).get("scenario")
+            if scen:
+                # scenario panel: fed by the workload runner's
+                # published snapshot (obs/scorecard.publish_scenario)
+                print(f"== scenario {scen.get('name', '?')} ==")
+                print(f"  phase={scen.get('phase', '?'):<10s} "
+                      f"tick={scen.get('tick', 0)}/"
+                      f"{scen.get('ticks', 0)} "
+                      f"t={scen.get('virtual_t', 0)}s "
+                      f"writes={scen.get('writes', 0)} "
+                      f"reads={scen.get('reads', 0)} "
+                      f"errors={scen.get('errors', 0)}")
+                print(f"  {scen.get('verdict', '')}")
             series = ts.get("series") or {}
             for name, row in sorted(series.items()):
                 print(f"  {name:<28s} "
@@ -837,6 +852,58 @@ def cmd_dt_trace(args) -> int:
             print(render_human(rep, agg if i == len(reports) - 1
                                else None))
     return rc
+
+
+def cmd_scenario(args) -> int:
+    """Declarative workload harness (workload/): `scenario list`
+    prints the registry; `scenario run --name X` drives the scenario
+    through serve+replicate+read against the live SLO engine and
+    emits its versioned scorecard (exit 0 iff the run converged with
+    SLOs intact and zero transport errors)."""
+    from ..workload import SCENARIOS, get_scenario, run_scenario
+    if args.action == "list":
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            mark = " [slow]" if sc.slow else ""
+            print(f"{name:<16s}{mark:>7s}  {sc.description}")
+        return 0
+    if not args.name:
+        print("scenario run: --name is required (see `scenario list`)",
+              file=sys.stderr)
+        return 2
+    try:
+        sc = get_scenario(args.name)
+    except ValueError as e:
+        print(f"scenario: {e}", file=sys.stderr)
+        return 2
+    if args.seed is not None:
+        import dataclasses
+        sc = dataclasses.replace(sc, seed=args.seed)
+    card = run_scenario(sc, data_dir=args.data_dir,
+                        progress=args.progress)
+    print(json.dumps(card, indent=1 if args.json else None))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(card, indent=1) + "\n")
+    return 0 if card["ok"] else 1
+
+
+def cmd_scorecard_diff(args) -> int:
+    """Compare two scenario scorecards metric-by-metric against the
+    per-metric tolerance bands (obs/scorecard.py). Always prints the
+    diff; with --gate the exit code is non-zero iff any gated metric
+    moved in its bad direction past its band — the one-diff
+    regression check BASELINE.md scenario rows hang off."""
+    from ..obs.scorecard import diff_scorecards, render_diff
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    diff = diff_scorecards(old, new)
+    print(json.dumps(diff) if args.json else render_diff(diff))
+    if args.gate and not diff["ok"]:
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -1197,6 +1264,39 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true",
                    help="print the assembled report(s) as JSON")
     c.set_defaults(fn=cmd_dt_trace)
+
+    c = sub.add_parser(
+        "scenario",
+        help="declarative workload harness: run a registered scenario "
+        "(serve+replicate+read against the live SLO engine) and emit "
+        "its versioned scorecard, or list the registry")
+    c.add_argument("action", choices=("run", "list"))
+    c.add_argument("--name",
+                   help="registered scenario name (see `scenario list`)")
+    c.add_argument("--seed", type=int, default=None,
+                   help="override the scenario's registered seed")
+    c.add_argument("--out",
+                   help="also write the scorecard JSON to this file")
+    c.add_argument("--data-dir",
+                   help="bank-lane home directory (default: a fresh "
+                   "temp dir, removed afterwards)")
+    c.add_argument("--progress", action="store_true")
+    c.add_argument("--json", action="store_true",
+                   help="pretty-print the scorecard")
+    c.set_defaults(fn=cmd_scenario)
+
+    c = sub.add_parser(
+        "scorecard-diff",
+        help="compare two scenario scorecards against per-metric "
+        "tolerance bands; --gate exits non-zero on regression")
+    c.add_argument("old", help="baseline scorecard JSON file")
+    c.add_argument("new", help="candidate scorecard JSON file")
+    c.add_argument("--gate", action="store_true",
+                   help="exit non-zero when any gated metric moved in "
+                   "its bad direction past its tolerance band")
+    c.add_argument("--json", action="store_true",
+                   help="print the diff as JSON")
+    c.set_defaults(fn=cmd_scorecard_diff)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,425 @@
+"""Scenario runner: drive serve+replicate+read against the SLO engine.
+
+Execution model (deterministic from the scenario seed):
+
+  1. **Schedule** — every interactive write, read, bulk-import op and
+     session-churn event is generated up front on the virtual clock
+     (arrivals.py / popularity.py), then sorted into `tick_s` buckets.
+  2. **Boot** — N in-process sync servers on ephemeral ports (the
+     replicate-soak boot pattern: follower reads on, sample_rate=1.0
+     so journeys and convergence lag populate), wired into one mesh
+     whose control plane is stepped inline once per tick — probes,
+     lease maintenance, anti-entropy — never free-running threads.
+  3. **Drive** — each tick executes its bucket over real HTTP (writes
+     POST /doc/{id}/edit, reads GET /doc/{id} round-robin across the
+     mesh so followers serve them), steps the control plane, evaluates
+     every node's SLO engine and integrates burn-minutes (a tick in a
+     non-ok state charges tick_s/60 to that objective, summed across
+     nodes), and publishes the live snapshot obs-watch renders.
+  4. **Bank lane** — scenarios with a `bank` section then churn docs
+     through an undersized Hydrator warm tier wired to the primary
+     server's ServeMetrics, so device-tier spills land in the same
+     hydration block /metrics and the scorecard read.
+  5. **Reconcile + scorecard** — anti-entropy rounds until every
+     server holds byte-identical text, then the run is snapshotted
+     into a versioned scorecard (obs/scorecard.py).
+
+Wall time is bounded by real work: nothing sleeps to simulate load.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..analysis.witness import make_lock
+from ..obs.hist import Histogram
+from ..obs.scorecard import build_scorecard, publish_scenario
+from .arrivals import make_arrivals
+from .popularity import Zipf, make_popularity
+from .spec import Scenario
+
+_WRITE_TOKENS = ("edit", "merge", "patch", "sync", "word", "line")
+
+
+class _Session:
+    """One editing session: an agent name plus its last-known version
+    per doc (the `version` field each edit applies at). Churn retires
+    the whole object and mints a fresh agent name."""
+
+    def __init__(self, tenant: int, slot: int, gen: int) -> None:
+        self.agent = f"t{tenant}s{slot}g{gen}"
+        self.versions: Dict[str, list] = {}
+
+
+class _Counts:
+    def __init__(self) -> None:
+        self.writes = 0          # successful interactive edit calls
+        self.write_ops = 0
+        self.reads = 0
+        self.read_refusals = 0   # follower 503s (staleness contract)
+        self.bulk_ops = 0
+        self.bank_edits = 0
+        self.errors = 0
+        self.bytes_sent = 0
+        self.bytes_received = 0
+
+    def ops(self) -> int:
+        return self.writes + self.reads + self.bulk_ops \
+            + self.bank_edits
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"ops": self.ops(), "writes": self.writes,
+                "write_ops": self.write_ops, "reads": self.reads,
+                "read_refusals": self.read_refusals,
+                "bulk_ops": self.bulk_ops,
+                "bank_edits": self.bank_edits, "errors": self.errors,
+                "bytes_sent": self.bytes_sent,
+                "bytes_received": self.bytes_received}
+
+
+def _build_events(sc: Scenario) -> List[tuple]:
+    """The full deterministic event tape: (t, kind, payload) sorted by
+    virtual time. Kinds: write(doc_idx, n), read(doc_idx), bulk(tenant),
+    churn()."""
+    events: List[tuple] = []
+    writes = make_arrivals(sc.arrivals, seed=sc.seed)
+    times = writes.schedule(sc.duration_s)
+    docs = make_popularity(sc.popularity, len(sc.doc_ids()),
+                           seed=sc.seed).draws(times)
+    acc = 0.0
+    for t, d in zip(times, docs):
+        events.append((t, "write", d))
+        acc += sc.reads_per_write
+        n_reads, acc = int(acc), acc - int(acc)
+        for j in range(n_reads):
+            events.append((t, "read", d))
+    if sc.bulk:
+        bulk = make_arrivals(sc.bulk["arrivals"], seed=sc.seed + 1)
+        for i, t in enumerate(bulk.schedule(sc.duration_s)):
+            events.append((t, "bulk", i % sc.tenants))
+    if sc.session_churn_every_s > 0:
+        t = sc.session_churn_every_s
+        while t < sc.duration_s:
+            events.append((t, "churn", None))
+            t += sc.session_churn_every_s
+    events.sort(key=lambda e: (e[0], e[1]))
+    return events
+
+
+def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
+                 progress: bool = False) -> dict:
+    from ..replicate.node import attach_replication
+    from ..tools.server import serve
+
+    rng = random.Random(f"runner:{sc.name}:{sc.seed}")
+    events = _build_events(sc)
+    doc_ids = sc.doc_ids()
+    counts = _Counts()
+    read_latency = Histogram()
+    t_start = time.monotonic()
+
+    # ---- boot the mesh (replicate-soak pattern, stepped inline) ----------
+    httpds, nodes, addrs = [], [], []
+    for i in range(sc.servers):
+        httpd = serve(port=0, serve_shards=sc.serve_shards,
+                      data_dir=None, follower_reads=True,
+                      obs_opts=dict(sample_rate=1.0))
+        httpds.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    for i, httpd in enumerate(httpds):
+        if sc.servers > 1:
+            node = attach_replication(
+                httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+                seed=sc.seed, lease_ttl_s=1.0, timeout_s=2.0,
+                backoff_base_s=0.02, backoff_cap_s=0.1)
+            nodes.append(node)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+    def step_control_plane() -> None:
+        for node in nodes:
+            node.table.probe_once()
+            node.maintain()
+        for node in nodes:
+            node.antientropy.run_round()
+
+    # ---- HTTP primitives -------------------------------------------------
+    def post_edit(si: int, doc: str, session: _Session,
+                  ops: List[dict]) -> bool:
+        body = json.dumps({"agent": session.agent,
+                           "version": session.versions.get(doc, []),
+                           "ops": ops}).encode("utf8")
+        req = urllib.request.Request(
+            f"http://{addrs[si]}/doc/{doc}/edit", data=body)
+        counts.bytes_sent += len(body)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                resp = r.read()
+        except OSError:
+            counts.errors += 1
+            return False
+        counts.bytes_received += len(resp)
+        session.versions[doc] = json.loads(resp)["version"]
+        return True
+
+    def get_doc(si: int, doc: str) -> None:
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addrs[si]}/doc/{doc}", timeout=5) as r:
+                counts.bytes_received += len(r.read())
+        except urllib.error.HTTPError as e:
+            e.close()
+            if e.code == 503:     # honest staleness refusal, not a bug
+                counts.read_refusals += 1
+            else:
+                counts.errors += 1
+            return
+        except OSError:
+            counts.errors += 1
+            return
+        counts.reads += 1
+        read_latency.record(time.monotonic() - t0)
+
+    # ---- sessions --------------------------------------------------------
+    gen = 0
+    sessions: Dict[int, List[_Session]] = {
+        t: [_Session(t, k, gen) for k in range(sc.sessions_per_tenant)]
+        for t in range(sc.tenants)}
+    session_churns = 0
+
+    # ---- tick loop -------------------------------------------------------
+    ticks = max(int(sc.duration_s / sc.tick_s + 0.999999), 1)
+    # zero-filled per objective so the scorecard column is explicit
+    # (and diffable) even on a fully healthy run
+    burn_minutes: Dict[str, float] = {
+        o.name: 0.0 for o in httpds[0].store.obs.slo.objectives}
+    ev_i = 0
+
+    def publish(phase: str, tick: int, extra: str = "") -> None:
+        worst, names = "ok", []
+        for httpd in httpds:
+            v = httpd.store.obs.slo.verdict()
+            if v["burning"]:
+                worst = "burning"
+                names += v["burning"]
+            elif v["warning"] and worst != "burning":
+                worst = "warning"
+                names += v["warning"]
+        publish_scenario({
+            "name": sc.name, "phase": phase,
+            "tick": tick, "ticks": ticks,
+            "virtual_t": round(min(tick * sc.tick_s, sc.duration_s), 2),
+            "writes": counts.writes, "reads": counts.reads,
+            "errors": counts.errors,
+            "slo_state": worst,
+            "verdict": (f"slo={worst}"
+                        + (" [" + ",".join(sorted(set(names))) + "]"
+                           if names else "") + extra),
+        })
+
+    for tick in range(ticks):
+        horizon = (tick + 1) * sc.tick_s
+        while ev_i < len(events) and events[ev_i][0] < horizon:
+            t, kind, arg = events[ev_i]
+            ev_i += 1
+            if kind == "write":
+                doc = doc_ids[arg]
+                tenant = int(doc[1:doc.index("-")])
+                ses = sessions[tenant][
+                    rng.randrange(sc.sessions_per_tenant)]
+                tok = f"{rng.choice(_WRITE_TOKENS)} "
+                if post_edit(rng.randrange(sc.servers), doc, ses,
+                             [{"kind": "ins", "pos": 0, "text": tok}]):
+                    counts.writes += 1
+                    counts.write_ops += 1
+            elif kind == "read":
+                get_doc(rng.randrange(sc.servers), doc_ids[arg])
+            elif kind == "bulk":
+                tenant = arg
+                doc = f"t{tenant}-bulk000"
+                ses = sessions[tenant][0]
+                payload = "x" * int(sc.bulk.get("bytes_per_op", 1024))
+                if post_edit(rng.randrange(sc.servers), doc, ses,
+                             [{"kind": "ins", "pos": 0,
+                               "text": payload}]):
+                    counts.bulk_ops += 1
+            elif kind == "churn":
+                gen += 1
+                session_churns += 1
+                sessions = {
+                    t: [_Session(t, k, gen)
+                        for k in range(sc.sessions_per_tenant)]
+                    for t in range(sc.tenants)}
+        step_control_plane()
+        # burn-minute integration: a tick spent in a non-ok state
+        # charges tick_s/60 to that objective (summed across nodes —
+        # mesh-wide burn)
+        for httpd in httpds:
+            for row in httpd.store.obs.slo.evaluate():
+                if row["state"] != "ok":
+                    burn_minutes[row["name"]] = burn_minutes.get(
+                        row["name"], 0.0) + sc.tick_s / 60.0
+        publish("traffic", tick + 1)
+        if progress:    # pragma: no cover - human pacing output
+            print(f"  tick {tick + 1}/{ticks}: {counts.writes} writes "
+                  f"{counts.reads} reads {counts.errors} errors")
+
+    # ---- bank-churn lane (device-tier spill accounting) ------------------
+    bank_report = None
+    if sc.bank:
+        publish("bank-churn", ticks)
+        bank_report = _run_bank_lane(sc, httpds[0], rng, counts,
+                                     data_dir=data_dir,
+                                     progress=progress)
+
+    # ---- reconcile to convergence ----------------------------------------
+    publish("reconcile", ticks)
+    converged_after = None
+    for r in range(sc.reconcile_rounds):
+        step_control_plane()
+        if _converged(addrs, doc_ids):
+            converged_after = r + 1
+            break
+        time.sleep(0.02)    # let advert/breaker windows lapse
+    converged = _converged(addrs, doc_ids)
+
+    # ---- collect ---------------------------------------------------------
+    serve_snaps = [h.store.scheduler.metrics.snapshot()
+                   if h.store.scheduler is not None else None
+                   for h in httpds]
+    flush_p99 = max((s["latencies"]["flush"]["p99"]
+                     for s in serve_snaps if s), default=None)
+    vis_p99s = [h.store.obs.ts.quantile("journey.visibility", 0.99,
+                                        window_s=3600.0)
+                for h in httpds]
+    vis_p99 = max((v for v in vis_p99s if v > 0), default=0.0)
+    hydration: Dict[str, int] = {}
+    for s in serve_snaps:
+        if s:
+            for k, v in s["hydration"].items():
+                hydration[k] = hydration.get(k, 0) + v
+    slo_burning, slo_warning, slo_ok = [], [], True
+    for httpd in httpds:
+        v = httpd.store.obs.slo.verdict()
+        slo_ok = slo_ok and v["slo_ok"]
+        slo_burning += v["burning"]
+        slo_warning += v["warning"]
+    lag = {addrs[i]: n.obs.journey.lag_summary()
+           for i, n in enumerate(nodes)}
+    per_server = [{
+        "addr": addrs[i],
+        "flush_p99_s": (serve_snaps[i]["latencies"]["flush"]["p99"]
+                        if serve_snaps[i] else None),
+        "flushed_ops": (serve_snaps[i]["totals"]["flushed_ops"]
+                        if serve_snaps[i] else 0),
+        "visibility_p99_s": round(vis_p99s[i], 6),
+    } for i in range(sc.servers)]
+    wall_s = time.monotonic() - t_start
+    ok = bool(converged and slo_ok and counts.errors == 0)
+
+    card = build_scorecard(
+        scenario=sc.to_dict(),
+        wall_s=wall_s, virtual_s=sc.duration_s,
+        totals=counts.as_dict(),
+        latency_p99_s={
+            "flush": flush_p99,
+            "read": read_latency.snapshot()["p99"],
+            "visibility": round(vis_p99, 6),
+        },
+        latencies={"read": read_latency.snapshot()},
+        slo={"slo_ok": slo_ok,
+             "burning": sorted(set(slo_burning)),
+             "warning": sorted(set(slo_warning))},
+        burn_minutes=burn_minutes,
+        convergence={"converged": converged,
+                     "reconcile_rounds": converged_after,
+                     "lag": lag},
+        hydration=hydration,
+        per_server=per_server,
+        ok=ok,
+        extra={"session_churns": session_churns,
+               **({"bank": bank_report} if bank_report else {})},
+    )
+    publish("done", ticks, extra=f" ok={ok}")
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    return card
+
+
+def _run_bank_lane(sc: Scenario, primary, rng: random.Random,
+                   counts: _Counts, data_dir: Optional[str] = None,
+                   progress: bool = False) -> dict:
+    """Churn `bank.docs` docs through a `bank.warm_slots`-sized
+    Hydrator warm tier. The hydrator reports into the PRIMARY server's
+    ServeMetrics, so spills_to_snapshot / spill_bytes land in the same
+    hydration block the /metrics endpoint, prom families and scorecard
+    read. Docs materialize on first touch (a missing home loads as a
+    fresh oplog) — the population size costs nothing up front."""
+    import shutil
+    import tempfile
+
+    from ..serve.hydrate import Hydrator
+    from ..storage.tier import TieredStore
+
+    bank = sc.bank
+    root = data_dir or tempfile.mkdtemp(prefix="dt-scenario-bank-")
+    own_root = data_dir is None
+    guard = make_lock("workload.bank_oplog", "oplog")
+    metrics = primary.store.scheduler.metrics \
+        if primary.store.scheduler is not None else None
+    store = TieredStore(root)
+    hyd = Hydrator(store, workers=2, warm_max=bank["warm_slots"],
+                   evict_grace_s=0.0, oplog_lock=guard,
+                   metrics=metrics, seed=sc.seed)
+    law = Zipf(bank["docs"], s=1.1, seed=sc.seed + 2)
+    t0 = time.monotonic()
+    touched = set()
+    try:
+        for rnd in range(bank["rounds"]):
+            picks = law.draws([0.0] * bank["edits_per_round"])
+            for j, d in enumerate(picks):
+                doc = f"bank{d:07d}"
+                ol = hyd.resolve(doc)
+                a = ol.get_or_create_agent_id(f"bank{sc.seed}")
+                with guard:
+                    ol.add_insert(a, 0, f"<{rnd}.{j}> ")
+                counts.bank_edits += 1
+                touched.add(doc)
+            if progress:    # pragma: no cover - human pacing output
+                print(f"  bank round {rnd + 1}/{bank['rounds']}: "
+                      f"{counts.bank_edits} edits, "
+                      f"{hyd.warm_count()} warm")
+    finally:
+        hyd.stop(checkpoint=True)
+    snap = hyd.counters_snapshot()
+    return {"docs": bank["docs"], "warm_slots": bank["warm_slots"],
+            "docs_touched": len(touched),
+            "edits": counts.bank_edits,
+            "spills_to_snapshot": snap.get("spills_to_snapshot", 0),
+            "spill_bytes": snap.get("spill_bytes", 0),
+            "wall_s": round(time.monotonic() - t0, 3),
+            "cleaned": own_root and bool(
+                shutil.rmtree(root, ignore_errors=True) or True)}
+
+
+def _converged(addrs: List[str], doc_ids: List[str]) -> bool:
+    for d in doc_ids:
+        texts = set()
+        for a in addrs:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{a}/doc/{d}", timeout=5) as r:
+                    texts.add(r.read())
+            except OSError:
+                return False
+        if len(texts) > 1:
+            return False
+    return True

@@ -1,0 +1,242 @@
+"""Workload-harness tests: seeded statistical bounds for the arrival
+and popularity samplers, the tier-1 smoke scenario's scorecard, and
+the scorecard-diff regression gate."""
+
+import copy
+import json
+import math
+
+import pytest
+
+from diamond_types_tpu.obs import Observability
+from diamond_types_tpu.obs.prom import render_metrics
+from diamond_types_tpu.obs.scorecard import (SCORECARD_VERSION, Band,
+                                             diff_scorecards,
+                                             last_scenario,
+                                             publish_scenario)
+from diamond_types_tpu.serve.metrics import HYDRATION_KEYS, ServeMetrics
+from diamond_types_tpu.tools import cli
+from diamond_types_tpu.workload import (SCENARIOS, Bursty, HotSetRotation,
+                                        Poisson, Ramp, Zipf)
+from diamond_types_tpu.workload.runner import _build_events
+
+pytestmark = pytest.mark.scenario
+
+
+# ---- arrival processes ---------------------------------------------------
+
+def test_poisson_rate_and_interarrival_quantiles():
+    rate, dur = 50.0, 100.0
+    times = Poisson(rate, seed=3).schedule(dur)
+    # count within 4 sigma of rate*dur (Poisson sd = sqrt(n))
+    expect = rate * dur
+    assert abs(len(times) - expect) < 4 * math.sqrt(expect)
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    mean = sum(gaps) / len(gaps)
+    assert abs(mean - 1.0 / rate) < 0.15 / rate
+    # exponential median = ln2/rate
+    p50 = sorted(gaps)[len(gaps) // 2]
+    assert abs(p50 - math.log(2) / rate) < 0.2 * math.log(2) / rate
+    assert times == sorted(times)
+    assert all(0.0 <= t < dur for t in times)
+
+
+def test_poisson_schedule_deterministic():
+    a = Poisson(20.0, seed=9).schedule(30.0)
+    b = Poisson(20.0, seed=9).schedule(30.0)
+    assert a == b                       # byte-identical across runs
+    proc = Poisson(20.0, seed=9)
+    assert proc.schedule(30.0) == a     # and across calls
+    assert Poisson(20.0, seed=10).schedule(30.0) != a
+
+
+def test_bursty_flash_crowd_concentration():
+    proc = Bursty(base_per_s=10.0, burst_x=10.0, every_s=10.0,
+                  burst_len_s=2.0, seed=5)
+    times = proc.schedule(100.0)
+    in_burst = sum(1 for t in times if proc.in_burst(t))
+    out = len(times) - in_burst
+    # burst windows are 20% of the clock at 10x rate: per-second
+    # intensity in-burst must dominate by far more than the window
+    # ratio alone (100/20 vs 100/80 normalizes the unequal spans)
+    assert (in_burst / 20.0) > 5 * (out / 80.0)
+    assert proc.schedule(100.0) == times
+
+
+def test_ramp_shifts_mass_late():
+    times = Ramp(start_per_s=0.0, end_per_s=50.0, ramp_s=50.0,
+                 seed=2).schedule(50.0)
+    early = sum(1 for t in times if t < 25.0)
+    late = len(times) - early
+    # linear 0->50 puts 3x the mass in the second half
+    assert late > 2 * early
+
+
+# ---- popularity laws -----------------------------------------------------
+
+def test_zipf_frequency_ranks():
+    n, draws = 40, 30_000
+    law = Zipf(n, s=1.1, seed=4)
+    picks = law.draws([0.0] * draws)
+    counts = [0] * n
+    for d in picks:
+        counts[d] += 1
+    # monotone head: rank order matches weight order
+    assert counts[0] > counts[3] > counts[10] > counts[30]
+    # head frequency within 25% of the law's own weight
+    assert abs(counts[0] / draws - law.weight(0)) < 0.25 * law.weight(0)
+    assert picks == law.draws([0.0] * draws)     # deterministic
+    assert picks != Zipf(n, s=1.1, seed=5).draws([0.0] * draws)
+
+
+def test_hotset_rotation_concentrates_and_rotates():
+    law = HotSetRotation(100, hot_k=2, hot_weight=0.9,
+                         rotate_every_s=1000.0, seed=6)
+    picks = law.draws([0.0] * 5_000)
+    hot = set(law.hot_set(0.0))
+    frac = sum(1 for d in picks if d in hot) / len(picks)
+    assert frac > 0.8                   # 0.9 weight + uniform residue
+    # a later epoch draws a different seeded hot set
+    rotating = HotSetRotation(100, hot_k=2, rotate_every_s=1.0, seed=6)
+    sets = {tuple(rotating.hot_set(float(e))) for e in range(8)}
+    assert len(sets) > 1
+
+
+def test_event_tape_deterministic():
+    sc = SCENARIOS["smoke"]
+    assert _build_events(sc) == _build_events(sc)
+
+
+# ---- registry ------------------------------------------------------------
+
+def test_registry_has_smoke_and_bank_churn():
+    assert "smoke" in SCENARIOS
+    assert not SCENARIOS["smoke"].slow
+    bank = SCENARIOS["bank-churn-1m"]
+    assert bank.slow
+    assert bank.bank["docs"] == 1_000_000
+    assert bank.bank["warm_slots"] == 10_000
+
+
+# ---- spill counters (PR 8 residual) --------------------------------------
+
+def test_hydration_keys_include_spill_counters():
+    assert "spills_to_snapshot" in HYDRATION_KEYS
+    assert "spill_bytes" in HYDRATION_KEYS
+
+
+def test_prom_spill_families_zero_filled_when_idle():
+    m = ServeMetrics(n_shards=1, flush_docs=4, max_pending=16)
+    text = render_metrics({"serve": m.snapshot()})
+    assert "dt_serve_hydration_spills_to_snapshot_total 0" in text
+    assert "dt_serve_hydration_spill_bytes_total 0" in text
+
+
+# ---- the smoke scenario + scorecard (acceptance pins) --------------------
+
+@pytest.fixture(scope="module")
+def smoke_card_path(tmp_path_factory):
+    out = tmp_path_factory.mktemp("scorecards") / "smoke.json"
+    rc = cli.main(["scenario", "run", "--name", "smoke",
+                   "--out", str(out)])
+    assert rc == 0
+    return out
+
+
+def test_smoke_scorecard_complete(smoke_card_path):
+    card = json.loads(smoke_card_path.read_text())
+    assert card["version"] == SCORECARD_VERSION
+    assert card["scenario"]["name"] == "smoke"
+    assert card["throughput"]["ops_per_s"] > 0
+    for k in ("flush", "read", "visibility"):
+        assert isinstance(card["latency_p99_s"][k], float)
+    assert card["latency_p99_s"]["read"] > 0
+    # burn-minutes zero-filled per objective on a healthy run
+    for name in ("flush_p99", "read_staleness_p99", "visibility_p99"):
+        assert card["burn_minutes"][name] == 0.0
+    assert card["convergence"]["converged"] is True
+    # per-peer convergence lag populated (owner side tracks journeys)
+    lags = [row for peers in card["convergence"]["lag"].values()
+            for row in peers.values()]
+    assert lags and all(r["n"] > 0 for r in lags)
+    assert card["bytes_per_op"] > 0
+    # device-tier spill accounting stamped into the scorecard: the
+    # smoke bank lane (48 docs / 8 slots) must actually spill
+    assert card["hydration"]["spills_to_snapshot"] > 0
+    assert card["hydration"]["spill_bytes"] > 0
+    assert card["totals"]["errors"] == 0
+    assert card["ok"] is True
+
+
+def test_scorecard_diff_self_compare_passes(smoke_card_path):
+    p = str(smoke_card_path)
+    assert cli.main(["scorecard-diff", p, p, "--gate"]) == 0
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda c: c["latency_p99_s"].__setitem__(
+        "flush", (c["latency_p99_s"]["flush"] or 0) * 10 + 1.0),
+    lambda c: c["throughput"].__setitem__(
+        "ops_per_s", c["throughput"]["ops_per_s"] * 0.3),
+    lambda c: c["totals"].__setitem__("errors", 3),
+    lambda c: c["convergence"].__setitem__("converged", False),
+])
+def test_scorecard_diff_gates_on_perturbation(smoke_card_path,
+                                              tmp_path, mutate):
+    card = json.loads(smoke_card_path.read_text())
+    mutate(card)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(card))
+    p = str(smoke_card_path)
+    assert cli.main(["scorecard-diff", p, str(bad), "--gate"]) == 1
+    # without --gate the diff is informational: always exit 0
+    assert cli.main(["scorecard-diff", p, str(bad)]) == 0
+
+
+def test_scorecard_diff_missing_metric_never_gates(smoke_card_path,
+                                                   tmp_path):
+    card = json.loads(smoke_card_path.read_text())
+    del card["hydration"]["spills_to_snapshot"]
+    trimmed = tmp_path / "trimmed.json"
+    trimmed.write_text(json.dumps(card))
+    assert cli.main(["scorecard-diff", str(smoke_card_path),
+                     str(trimmed), "--gate"]) == 0
+
+
+def test_band_absolute_slack_floors_relative():
+    band = Band("lower", rel=0.5, abs_=0.01)
+    assert band.allows(0.001, 0.009)    # inside abs slack
+    assert not band.allows(0.001, 0.10)
+    assert band.allows(10.0, 14.0)      # inside rel band
+    assert not band.allows(10.0, 16.0)
+    up = Band("higher", rel=0.3, abs_=0.0)
+    assert up.allows(100.0, 80.0)
+    assert not up.allows(100.0, 60.0)
+    assert up.allows(100.0, 500.0)      # improvement always passes
+
+
+def test_diff_engine_rows_and_regressions(smoke_card_path):
+    card = json.loads(smoke_card_path.read_text())
+    worse = copy.deepcopy(card)
+    worse["bytes_per_op"] = card["bytes_per_op"] * 3 + 1000
+    diff = diff_scorecards(card, worse)
+    assert not diff["ok"]
+    assert diff["regressions"] == ["bytes_per_op"]
+    self_diff = diff_scorecards(card, card)
+    assert self_diff["ok"] and not self_diff["regressions"]
+
+
+# ---- live snapshot -> obs (the obs-watch scenario panel feed) ------------
+
+def test_published_scenario_rides_obs_snapshot():
+    prev = last_scenario()
+    try:
+        publish_scenario({"name": "smoke", "phase": "traffic",
+                          "tick": 3, "ticks": 6, "verdict": "slo=ok"})
+        snap = Observability(enabled=False).snapshot()
+        assert snap["scenario"]["name"] == "smoke"
+        assert snap["scenario"]["phase"] == "traffic"
+        publish_scenario(None)
+        assert "scenario" not in Observability(enabled=False).snapshot()
+    finally:
+        publish_scenario(prev)
