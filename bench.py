@@ -1647,6 +1647,25 @@ def _main() -> None:
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 
+    # Wire-frame codec micro-bench (wire/ tier): a churn op tape
+    # through each frame codec vs its JSON twin — the summary keeps
+    # the transport ratios the mesh scenario runs bank on (same row
+    # `cli wire-bench` prints)
+    try:
+        from diamond_types_tpu.tools.cli import wire_bench
+        wb = wire_bench()
+        full["wire"] = wb
+        extra["wire"] = {
+            "ops_encode_per_sec": wb["ops"]["encode_per_sec"],
+            "ops_decode_per_sec": wb["ops"]["decode_per_sec"],
+            "ops_ratio": wb["ops"]["ratio"],
+            "summary_ratio": wb["summary"]["ratio"],
+            "patch_ratio": wb["patch"]["ratio"],
+            "docs_ratio": wb["docs"]["ratio"],
+        }
+    except Exception as e:  # pragma: no cover
+        extra["wire_error"] = str(e)[:120]
+
     # Follower-read A/B (read/ tier): two-server mesh, Zipf readers at
     # each doc's non-owner replica — bounded-staleness local serving
     # vs the owner-only-proxy control, with client-side staleness +

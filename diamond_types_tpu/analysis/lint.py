@@ -49,7 +49,7 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
 DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
-                    "storage", "read", "obs", "workload")
+                    "storage", "read", "obs", "workload", "wire")
 
 SEVERITY = {
     "lock-order": "error",

@@ -112,6 +112,12 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     # stays outer to oplog and never the reverse
     if "_read_lock" in src or "_cache_lock" in src:
         return "io"
+    # wire tier: the WireChannel snapshot-frame cache guard is io-rung
+    # for the same reason as the checkout cache — frame builds (which
+    # take the oplog guard) run strictly OUTSIDE the cache lock, so a
+    # racing pair builds twice rather than ever nesting io inside oplog
+    if "_frame_cache_lock" in src:
+        return "io"
     # device-transform planning: the xform jit-cache guard is a
     # DEVICE-class lock (the batched transform dispatch runs in the
     # planning phase, under shard locks but outside the oplog guard and

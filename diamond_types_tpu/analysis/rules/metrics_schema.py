@@ -20,6 +20,15 @@ copied, so the rule can never drift from the schema itself):
   ._bump("key") / .bump("key") key in SOME declared single-key surface
   .record_hydration("key")     key in HYDRATION_KEYS
   .observe_latency("name")     name in the replication histogram set
+  .bump_wire("chan", "key")    chan in wire.frames.WIRE_CHANNELS and
+                               key in WIRE_KEYS (the flat `wire` group
+                               key is derived as f"{chan}_{key}", so
+                               the generic literal check can't see it)
+  .account("chan", sent_bytes=...)  WireChannel accounting entrypoint:
+                               chan in WIRE_CHANNELS (only calls that
+                               pass a wire accounting keyword are
+                               matched — `.account` alone is too
+                               generic a method name)
 
 plus the exemplar join: a module defining `_EXEMPLAR_FAMILIES` (the
 prom histogram -> TimeSeries mapping) must only name families some
@@ -43,6 +52,11 @@ from ...read.metrics import READ_KEYS
 from ...replicate.metrics import _GROUPS, _LATENCY_NAMES
 from ...serve.metrics import HYDRATION_KEYS, _SHARD_KEYS
 from ...storage.tier import TIER_KEYS
+from ...wire.frames import WIRE_CHANNELS, WIRE_KEYS
+
+# keywords that mark an `.account(...)` call as wire accounting (the
+# bare method name is too generic to match on its own)
+_WIRE_ACCOUNT_KWARGS = {"sent_bytes", "json_bytes", "framed", "snapshot"}
 
 _GROUP_KEYS = {k for keys in _GROUPS.values() for k in keys}
 # every declared single-key surface a bare `.bump("key")` may target
@@ -102,6 +116,28 @@ def check_metrics_schema(ctx: FileContext, summary) -> List[Violation]:
                         violate(node.lineno,
                                 f"bump key {a1!r} is not declared on "
                                 f"any metrics surface")
+            elif name == "bump_wire" and args:
+                a0 = _const_str(args[0])
+                a1 = _const_str(args[1]) if len(args) > 1 else None
+                if a0 is not None and a0 not in WIRE_CHANNELS:
+                    violate(node.lineno,
+                            f"wire channel {a0!r} is not in "
+                            f"wire.frames.WIRE_CHANNELS "
+                            f"{WIRE_CHANNELS} — the dt_wire_* prom "
+                            f"families will never export it")
+                if a1 is not None and a1 not in WIRE_KEYS:
+                    violate(node.lineno,
+                            f"wire key {a1!r} is not in "
+                            f"wire.frames.WIRE_KEYS {WIRE_KEYS}")
+            elif name == "account" and args and any(
+                    kw.arg in _WIRE_ACCOUNT_KWARGS
+                    for kw in node.keywords):
+                a0 = _const_str(args[0])
+                if a0 is not None and a0 not in WIRE_CHANNELS:
+                    violate(node.lineno,
+                            f"wire channel {a0!r} is not in "
+                            f"wire.frames.WIRE_CHANNELS "
+                            f"{WIRE_CHANNELS}")
             elif name == "record_hydration" and args:
                 a0 = _const_str(args[0])
                 if a0 is not None and a0 not in HYDRATION_KEYS:

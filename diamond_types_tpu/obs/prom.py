@@ -13,6 +13,9 @@ Naming scheme:
   dt_repl_<group>_<key>_total         replication counters
   dt_rebalance_<counter>_total /      elastic-mesh migrations (zero-
   dt_rebalance_override_table_size    filled) + override-table gauge
+  dt_wire_<key>_total{channel}        wire-tier transport accounting
+                                      (bytes_sent, bytes_saved, frames,
+                                      snapshot_ships per channel)
   dt_read_<counter>_total             follower-read tier counters
   dt_read_local_ratio /               local-serve ratio gauge +
   dt_read_staleness_seconds           staleness histogram
@@ -269,14 +272,29 @@ def _render_replication(b: _Builder, repl: dict) -> None:
                 b.add("dt_rebalance_override_table_size", "gauge", v)
             else:
                 b.add(f"dt_rebalance_{k}_total", "counter", v)
+    # wire tier: per-channel transport accounting as dedicated labeled
+    # dt_wire_* families — the flat `{channel}_{key}` snapshot keys
+    # split back into a channel label so dashboards can sum/stack the
+    # four transport channels without regex gymnastics.
+    wire = repl.get("wire")
+    if isinstance(wire, dict):
+        from ..wire.frames import WIRE_CHANNELS, WIRE_KEYS
+        for ch in WIRE_CHANNELS:
+            for key in WIRE_KEYS:
+                v = wire.get(f"{ch}_{key}")
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                b.add(f"dt_wire_{key}_total", "counter", v,
+                      labels={"channel": ch})
     for group, vals in sorted(repl.items()):
         if group in ("version", "self", "latencies") or \
                 not isinstance(vals, dict):
             continue
         if group in ("per_peer", "membership_view", "quorum_view",
-                     "faults", "rebalance"):
-            # rebalance rendered above under its own dt_rebalance_*
-            # prefix, not the generic dt_repl_* one
+                     "faults", "rebalance", "wire"):
+            # rebalance / wire rendered above under their own
+            # dt_rebalance_* / dt_wire_* prefixes, not the generic
+            # dt_repl_* one
             continue
         for k, v in sorted(vals.items()):
             if isinstance(v, bool) or not isinstance(v, (int, float)):

@@ -55,6 +55,7 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
                     burn_minutes: Optional[Dict[str, float]] = None,
                     convergence: Optional[dict] = None,
                     hydration: Optional[Dict[str, int]] = None,
+                    wire: Optional[Dict[str, Dict[str, float]]] = None,
                     per_server: Optional[List[dict]] = None,
                     ok: bool = True,
                     extra: Optional[dict] = None) -> dict:
@@ -91,6 +92,21 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
     }
     card["burn_minutes_total"] = round(
         sum(card["burn_minutes"].values()), 4)
+    if wire is not None:
+        # mesh-transport accounting per wire channel (raw counters
+        # summed across servers by the runner); bytes_per_op derived
+        # HERE so every producer divides by the same op count
+        card["wire"] = {
+            ch: {
+                "bytes_sent": int(vals.get("bytes_sent", 0)),
+                "bytes_saved": int(vals.get("bytes_saved", 0)),
+                "frames": int(vals.get("frames", 0)),
+                "snapshot_ships": int(vals.get("snapshot_ships", 0)),
+                "bytes_per_op": round(
+                    float(vals.get("bytes_sent", 0)) / max(ops, 1.0), 2),
+            }
+            for ch, vals in sorted(wire.items())
+        }
     if latencies is not None:
         card["latencies"] = latencies
     if per_server is not None:
@@ -139,6 +155,12 @@ DEFAULT_BANDS: Dict[str, Band] = {
     "hydration.spill_bytes": Band("lower", rel=1.0, abs_=262144.0),
     "hydration.quarantined": Band("lower", rel=0.0, abs_=0.0),
     "hydration.flush_leaks": Band("lower", rel=0.0, abs_=0.0),
+    # wire tier: per-channel transport cost. Absent from pre-wire (or
+    # single-server) scorecards — missing paths report but never gate.
+    "wire.antientropy.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
+    "wire.proxy.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
+    "wire.hydrate.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
+    "wire.gossip.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
 }
 
 # Boolean invariants: must never flip good -> bad.

@@ -190,6 +190,13 @@ class ReadPath:
         ctx = span.context() if span.sampled else trace
         if ctx is not None:
             headers[TRACE_HEADER] = format_context(ctx)
+        # advertise wire v1 so the owner may frame the mesh leg; the
+        # end client still gets JSON — the saving is hop-only
+        wire = getattr(node, "wire", None)
+        wire_hdr = wire.header_value() if wire is not None else None
+        if wire_hdr is not None:
+            from ..wire.frames import WIRE_HEADER
+            headers[WIRE_HEADER] = wire_hdr
         try:
             status, body = node.table.call(
                 owner, f"/doc/{doc_id}/state",
@@ -205,8 +212,17 @@ class ReadPath:
                 return None
             return self._refuse(f"{reason}; owner answered {status}")
         try:
-            state = json.loads(body)
-            text, remote = state["text"], state["version"]
+            from ..wire.frames import (FRAME_STATE, WireError,
+                                       decode_frame, decode_state,
+                                       is_frame)
+            if is_frame(body):
+                ftype, payload = decode_frame(body)
+                if ftype != FRAME_STATE:
+                    raise WireError("proxy: expected STATE frame")
+                text, remote = decode_state(payload)
+            else:
+                state = json.loads(body)
+                text, remote = state["text"], state["version"]
         except (ValueError, KeyError, TypeError):
             span.end(outcome="bad_body")
             if soft_fail:
@@ -218,8 +234,10 @@ class ReadPath:
         out_headers = {FRONTIER_HEADER: json.dumps(remote),
                        SOURCE_HEADER: "proxied"}
         if kind == "state":
-            return ReadResult(200, body, "application/json",
-                              out_headers, "proxied")
+            # re-inflate for the client regardless of transport framing
+            return ReadResult(200, json.dumps(
+                {"text": text, "version": remote}).encode("utf8"),
+                "application/json", out_headers, "proxied")
         return ReadResult(200, text.encode("utf8"),
                           "text/plain; charset=utf-8", out_headers,
                           "proxied")

@@ -6,6 +6,13 @@ snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
 Changelog:
+  v7  wire tier: new `wire` group — per-channel transport accounting
+      (`{channel}_{bytes_sent,bytes_saved,frames,snapshot_ships}` for
+      the antientropy / proxy / hydrate / gossip channels, exported as
+      dedicated `dt_wire_*` prom families). Counts every send, framed
+      or JSON fallback, so before/after scorecards stay comparable.
+      Also `antientropy.docs_skipped` — per-doc handshakes elided by
+      the frontier short-circuit (equal advertised frontier).
   v6  `ae_ship` latency histogram — per-peer anti-entropy push round
       trip (encode→200), the owner-side half of the edit-to-visibility
       journey (obs/journey.py stamps ae_shipped/applied_at_peer off
@@ -37,9 +44,10 @@ Schema (snapshot()):
               "churn"},            # churn = acquires+takeovers+releases
    "handoffs": {"started", "completed", "failed",
                 "latency_s_total", "latency_s_max"},
-   "antientropy": {"rounds", "docs_checked", "docs_pulled",
-                   "docs_pushed", "bytes_pulled", "bytes_pushed",
-                   "errors", "frontier_adverts", "adverts_relayed"},
+   "antientropy": {"rounds", "docs_checked", "docs_skipped",
+                   "docs_pulled", "docs_pushed", "bytes_pulled",
+                   "bytes_pushed", "errors", "frontier_adverts",
+                   "adverts_relayed"},
    "rebalance": {"overrides_set", "overrides_cleared",
                  "override_merges", "migrations_started",
                  "migrations_completed", "migrations_aborted",
@@ -56,6 +64,10 @@ Schema (snapshot()):
                "rejoin_denials"},       # merges denied while rejoining
    "membership": {"joins", "leaves", "suspicions", "refutations",
                   "deaths"},
+   "wire": {f"{channel}_{key}"      # channel x key, flat
+            for channel in ("antientropy", "proxy", "hydrate", "gossip")
+            for key in ("bytes_sent", "bytes_saved", "frames",
+                        "snapshot_ships")},
    "latencies": {"handoff": hist, "quorum_round": hist,
                  "probe": hist, "antientropy_round": hist,
                  "rebalance_drain": hist, "ae_ship": hist},
@@ -72,6 +84,7 @@ import threading
 from typing import Dict
 
 from ..obs.hist import Histogram
+from ..wire.frames import WIRE_CHANNELS, WIRE_KEYS
 
 _LATENCY_NAMES = ("handoff", "quorum_round", "probe",
                   "antientropy_round", "rebalance_drain", "ae_ship")
@@ -80,9 +93,10 @@ _GROUPS = {
     "leases": ("acquires", "renewals", "takeovers", "releases",
                "tie_breaks"),
     "handoffs": ("started", "completed", "failed"),
-    "antientropy": ("rounds", "docs_checked", "docs_pulled",
-                    "docs_pushed", "bytes_pulled", "bytes_pushed",
-                    "errors", "frontier_adverts", "adverts_relayed"),
+    "antientropy": ("rounds", "docs_checked", "docs_skipped",
+                    "docs_pulled", "docs_pushed", "bytes_pulled",
+                    "bytes_pushed", "errors", "frontier_adverts",
+                    "adverts_relayed"),
     "rebalance": ("overrides_set", "overrides_cleared",
                   "override_merges", "migrations_started",
                   "migrations_completed", "migrations_aborted"),
@@ -97,12 +111,13 @@ _GROUPS = {
                 "rejoin_denials"),
     "membership": ("joins", "leaves", "suspicions", "refutations",
                    "deaths"),
+    "wire": tuple(f"{c}_{k}" for c in WIRE_CHANNELS for k in WIRE_KEYS),
 }
 
 
 class ReplicationMetrics:
-    # v5 -> v6: ae_ship latency histogram (see changelog)
-    SCHEMA_VERSION = 6
+    # v6 -> v7: per-channel wire transport group (see changelog)
+    SCHEMA_VERSION = 7
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id
@@ -137,6 +152,15 @@ class ReplicationMetrics:
     def observe_handoff_latency(self, seconds: float) -> None:
         self.observe_latency("handoff", seconds)
 
+    def bump_wire(self, channel: str, key: str, n: int = 1) -> None:
+        """One wire-tier count: ``channel`` in WIRE_CHANNELS, ``key``
+        in WIRE_KEYS — flattened into the ``wire`` group."""
+        self.bump("wire", f"{channel}_{key}", n)
+
+    def wire_counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c["wire"])
+
     def snapshot(self, leases_held: int = 0, per_peer: dict = None,
                  faults: dict = None, membership_view: dict = None,
                  quorum_view: dict = None,
@@ -169,6 +193,7 @@ class ReplicationMetrics:
                 "quorum": dict(self._c["quorum"]),
                 "fencing": dict(self._c["fencing"]),
                 "membership": dict(self._c["membership"]),
+                "wire": dict(self._c["wire"]),
                 "latencies": latencies,
                 "per_peer": per_peer or {},
                 "membership_view": membership_view,

@@ -59,6 +59,8 @@ from typing import List, Optional, Set, Tuple
 from ..causalgraph.summary import intersect_with_summary
 from ..encoding.encode import ENCODE_PATCH, encode_oplog
 from ..obs.trace import NOOP_SPAN, TRACE_HEADER, format_context
+from ..wire import WIRE_VERSION, WireChannel, WireError
+from ..wire.frames import FRAME_OPS, encode_frame, encode_ops
 from .antientropy import AntiEntropy
 from .faults import FaultInjector
 from .membership import ALIVE, LEFT, MembershipView
@@ -83,7 +85,8 @@ class ReplicaNode:
                  faults: Optional[FaultInjector] = None,
                  journal_prefix: Optional[str] = None,
                  obs=None, clock=None, table=None,
-                 journal=None) -> None:
+                 journal=None, wire_enabled: Optional[bool] = None,
+                 snapshot_ops_threshold: Optional[int] = None) -> None:
         self.store = store
         self.self_id = self_id
         # clock/table/journal are dependency seams: the model checker
@@ -102,6 +105,13 @@ class ReplicaNode:
         self.takeover_after_s = (lease_ttl_s if takeover_after_s is None
                                  else takeover_after_s)
         self.metrics = ReplicationMetrics(self_id)
+        # wire tier: binary framing + per-channel transport accounting.
+        # Negotiated per peer off ping gossip; framing can be pinned
+        # off (JSON fallback) while accounting stays on.
+        wire_opts = {} if snapshot_ops_threshold is None \
+            else {"snapshot_ops_threshold": snapshot_ops_threshold}
+        self.wire = WireChannel(metrics=self.metrics,
+                                enabled=wire_enabled, **wire_opts)
         self.faults = faults
         if table is not None:
             self.table = table
@@ -282,10 +292,27 @@ class ReplicaNode:
         ctx = span.context() if span.sampled else trace
         if ctx is not None:
             headers[TRACE_HEADER] = format_context(ctx)
+        # wire tier: a JSON edit body proxied to a v1 peer rides as one
+        # OPS frame (the receiver sniffs the magic). Any re-encode
+        # hiccup just sends the original JSON — correctness never
+        # depends on the frame path.
+        send_body, framed = body, False
+        if path.endswith("/edit") and self.wire.use_wire(target):
+            try:
+                frame = encode_frame(FRAME_OPS,
+                                     encode_ops(json.loads(body)),
+                                     compress=True)
+                if len(frame) < len(body):
+                    send_body, framed = frame, True
+            except (ValueError, KeyError, TypeError, WireError):
+                pass
         try:
             try:
-                status, resp = self.table.call(target, path, data=body,
+                status, resp = self.table.call(target, path,
+                                               data=send_body,
                                                headers=headers)
+                self.wire.account("proxy", sent_bytes=len(send_body),
+                                  json_bytes=len(body), framed=framed)
             except urllib.error.HTTPError as e:
                 # owner answered with an application error: relay it
                 status, resp = e.code, e.read()
@@ -497,6 +524,11 @@ class ReplicaNode:
                # held-lease count: the rebalancer's load signal
                "load": self.leases.held_count(),
                "members": self.membership.gossip_payload()}
+        # wire capability gossip: POST bodies can only be framed once
+        # the sender KNOWS the receiver decodes frames, and ping is the
+        # one channel every peer already exchanges
+        if self.wire.enabled:
+            out["wire"] = WIRE_VERSION
         overrides = self.overrides.gossip_payload()
         if overrides:
             out["overrides"] = overrides
@@ -552,6 +584,9 @@ class ReplicaNode:
     def _on_ping(self, peer_id: str, body: dict) -> None:
         """Probe-loop gossip hook: fold the responder's member table,
         and open transport to any member we just learned about."""
+        # wire capability: absent/0 = JSON-only peer (old build, or
+        # framing pinned off) — every POST body to it stays JSON
+        self.wire.note_peer(peer_id, body.get("wire"))
         members = body.get("members")
         if isinstance(members, dict):
             self.membership.merge_remote(members)
@@ -691,16 +726,18 @@ class ReplicaNode:
     def docs_json(self) -> dict:
         now = self.clock()
         doc_ids = self.store.doc_ids()
-        # follower-read frontier advertisement: our frontier per
-        # IN-MEMORY doc (not-yet-loaded .dt files aren't worth a load
-        # just to advertise). Computed under the store's oplog guard
-        # BEFORE the lease guard below — the two are never nested.
+        # frontier advertisement per IN-MEMORY doc (not-yet-loaded .dt
+        # files aren't worth a load just to advertise). Always included:
+        # the follower-read tier folds them as staleness evidence, and
+        # anti-entropy's frontier short-circuit skips the whole per-doc
+        # summary round trip when the advertised frontier matches.
+        # Computed under the store's oplog guard BEFORE the lease guard
+        # below — the two are never nested.
         frontiers = {}
-        if getattr(self.store, "reads", None) is not None:
-            with self.store.lock:
-                for doc_id, ol in self.store.docs.items():
-                    frontiers[doc_id] = \
-                        ol.cg.local_to_remote_frontier(ol.version)
+        with self.store.lock:
+            for doc_id, ol in self.store.docs.items():
+                frontiers[doc_id] = \
+                    ol.cg.local_to_remote_frontier(ol.version)
         docs = {}
         with self.leases.lock:
             for doc_id in doc_ids:
@@ -711,6 +748,27 @@ class ReplicaNode:
                 if doc_id in frontiers:
                     docs[doc_id]["frontier"] = frontiers[doc_id]
         return {"docs": docs, "self": self.self_id}
+
+    # ---- wire-tier snapshot fetch (hydrator hook) ------------------------
+
+    def fetch_remote_snapshot(self, doc_id: str) -> Optional[bytes]:
+        """One GET of the doc owner's compacted snapshot frame, for a
+        cold hydration miss whose durable home is empty. Best-effort:
+        any transport error, a 404 (old peer or unknown doc) or a
+        non-frame body returns None and the miss stays a fresh doc."""
+        from ..wire.frames import WIRE_HEADER, is_frame
+        target = self.route_mutation(doc_id)
+        if target == self.self_id or not self.wire.enabled:
+            return None
+        try:
+            st, body = self.table.call(
+                target, f"/doc/{doc_id}/snapshot",
+                headers={WIRE_HEADER: self.wire.header_value()})
+        except (OSError, urllib.error.HTTPError, KeyError):
+            return None
+        if st != 200 or not is_frame(body):
+            return None
+        return body
 
     # ---- metrics ---------------------------------------------------------
 
@@ -775,4 +833,11 @@ def attach_replication(httpd, self_id: str, peer_addrs: List[str],
     if getattr(store, "scheduler", None) is not None:
         store.scheduler.admit = node.owns
         store.scheduler.epoch_of = node.active_epoch
+        # wire tier: a cold hydration miss with an EMPTY durable home
+        # asks the doc's owner for one compacted snapshot frame (the
+        # `/doc/{id}/snapshot` endpoint is wire-v1-only, so a node
+        # pinned to JSON never fetches — old-peer semantics preserved)
+        hyd = getattr(store.scheduler, "hydrator", None)
+        if hyd is not None and node.wire.enabled:
+            hyd.remote_fetch = node.fetch_remote_snapshot
     return node

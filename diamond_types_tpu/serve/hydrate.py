@@ -91,6 +91,11 @@ class Hydrator:
         # installs (read.attach_follower_reads wires the checkout-cache
         # pre-materializer here). Invoked with NO hydrator locks held.
         self.on_warm = None
+        # wire tier: remote_fetch(doc_id) -> snapshot frame bytes (or
+        # None). Wired by attach_replication; a cold miss whose durable
+        # home is empty pulls the owner's compacted snapshot instead of
+        # serving a spuriously-fresh doc. Called lock-free.
+        self.remote_fetch = None
         self.backoff = backoff if backoff is not None else Backoff(
             base_s=0.002, cap_s=0.05, seed=seed, key="hydrate")
         self._hydrate_lock = make_lock("hydrate.warm", "io")
@@ -211,7 +216,7 @@ class Hydrator:
             with self._hydrate_lock:
                 self._pending.pop(doc_id, None)
             return
-        self._finish(doc_id, ol, t0)
+        self._finish(doc_id, self._maybe_remote_fill(doc_id, ol), t0)
 
     def _load_with_retries(self, doc_id: str, deadline: float):
         """One bounded retry ladder. Returns the hydrated OpLog, None
@@ -243,6 +248,24 @@ class Hydrator:
                     return None
                 time.sleep(min(self.backoff.delay(attempt - 1), left))
         return None
+
+    def _maybe_remote_fill(self, doc_id: str, ol):
+        """A hydration that came back EMPTY may be a doc this host has
+        simply never seen: ask the mesh (wire tier snapshot fetch)
+        before installing a fresh oplog. Best-effort — any failure
+        keeps the legitimate fresh-empty semantics."""
+        fetch = self.remote_fetch
+        if fetch is None or ol is None or len(ol) > 0:
+            return ol
+        try:
+            frame = fetch(doc_id)
+            if frame:
+                from ..wire.snapshot import apply_snapshot
+                if apply_snapshot(ol, frame):
+                    self._bump("remote_fills")
+        except Exception:
+            self._bump("remote_fill_errors")
+        return ol
 
     def _note_quarantined(self, doc_id: str) -> None:
         with self._hydrate_lock:
@@ -328,7 +351,8 @@ class Hydrator:
             self._bump("quarantined")
             self._note_quarantined(doc_id)
             raise DocQuarantined(doc_id, "hydration_timeout")
-        return self._finish(doc_id, ol, t0)
+        return self._finish(doc_id, self._maybe_remote_fill(doc_id, ol),
+                            t0)
 
     def wait_warm(self, doc_id: str, timeout_s: float) -> bool:
         """Wait (briefly) for an in-flight hydration to land. True when

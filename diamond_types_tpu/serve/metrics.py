@@ -89,6 +89,10 @@ HYDRATION_KEYS = (
     "spill_bytes",          # on-disk bytes those spills wrote (home
                             # file growth, clamped at 0 per spill —
                             # compaction can shrink the home)
+    "remote_fills",         # cold misses whose empty home was filled
+                            # from a peer's snapshot frame (wire tier)
+    "remote_fill_errors",   # remote snapshot fetch/apply failures
+                            # (doc stays a legitimate fresh-empty doc)
 )
 
 
@@ -120,8 +124,11 @@ class ServeMetrics:
     # that fell to the XLA fused rung);
     # v11 = device-tier spill accounting (`spills_to_snapshot` /
     # `spill_bytes` in the hydration block — scenario scorecards stamp
-    # these; prom exports them as dt_serve_hydration_spill*_total)
-    SCHEMA_VERSION = 11
+    # these; prom exports them as dt_serve_hydration_spill*_total);
+    # v12 = wire-tier remote hydration (`remote_fills` /
+    # `remote_fill_errors` in the hydration block — cold misses
+    # hydrated from a peer's compacted snapshot frame)
+    SCHEMA_VERSION = 12
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:

@@ -456,6 +456,119 @@ def cmd_read_bench(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def wire_bench(seed: int = 7, n_ops: int = 2000, agents: int = 8,
+               docs: int = 64) -> dict:
+    """Wire-frame codec micro-benchmark: a deterministic churn op tape
+    (unicode-heavy inserts/deletes, churning agent names) measured
+    through each frame codec against its JSON twin. Returns the row
+    `cli wire-bench` prints and bench.py ingests alongside serve_sched
+    (encode/decode ops/sec + bytes-on-the-wire ratios)."""
+    import random
+    import time as _time
+    from ..causalgraph.summary import summarize_versions
+    from ..encoding.encode import ENCODE_FULL, encode_oplog
+    from ..text.oplog import OpLog
+    from ..wire.frames import (FRAME_DOCS, FRAME_OPS, FRAME_PATCH,
+                               FRAME_SUMMARY, decode_frame, decode_docs,
+                               decode_ops, decode_summary, encode_docs,
+                               encode_frame, encode_ops, encode_summary)
+    rng = random.Random(f"wire-bench:{seed}")
+    alphabet = "etaoin shrdluéß世界\U0001f600"
+
+    # ---- churn tape: edit bodies exactly as the proxy channel sees them
+    reqs, doc_len = [], 0
+    for i in range(n_ops):
+        agent = f"t0s{i % agents}g{i // 97}"
+        if doc_len > 8 and rng.random() < 0.3:
+            start = rng.randrange(doc_len - 4)
+            end = min(doc_len, start + 1 + rng.randrange(4))
+            ops = [{"kind": "del", "start": start, "end": end}]
+            doc_len -= end - start
+        else:
+            text = "".join(rng.choice(alphabet)
+                           for _ in range(1 + rng.randrange(8)))
+            pos = rng.randrange(doc_len + 1)
+            ops = [{"kind": "ins", "pos": pos, "text": text}]
+            doc_len += len(text)
+        reqs.append({"agent": agent, "version": [[agent, max(i - 1, 0)]],
+                     "ops": ops})
+
+    t0 = _time.perf_counter()
+    frames = [encode_frame(FRAME_OPS, encode_ops(r), compress=True)
+              for r in reqs]
+    t_enc = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    back = [decode_ops(decode_frame(f)[1]) for f in frames]
+    t_dec = _time.perf_counter() - t0
+    if back != reqs:
+        raise AssertionError("wire-bench: OPS tape did not round-trip")
+    json_bytes = sum(len(json.dumps(r).encode("utf8")) for r in reqs)
+    frame_bytes = sum(len(f) for f in frames)
+    row = {"tape": {"n_ops": n_ops, "agents": agents, "seed": seed},
+           "ops": {
+               "encode_per_sec": round(n_ops / max(t_enc, 1e-9)),
+               "decode_per_sec": round(n_ops / max(t_dec, 1e-9)),
+               "json_bytes": json_bytes, "frame_bytes": frame_bytes,
+               "ratio": round(json_bytes / max(frame_bytes, 1), 2)}}
+
+    # ---- summary frame: replay the tape into an oplog, frame its
+    # version summary (what every anti-entropy handshake exchanges)
+    ol = OpLog()
+    for r in reqs:
+        a = ol.get_or_create_agent_id(r["agent"])
+        frontier = list(ol.version)
+        op = r["ops"][0]
+        if op["kind"] == "ins":
+            ol.add_insert_at(a, frontier, op["pos"], op["text"])
+        else:
+            ol.add_delete_at(a, frontier, op["start"], op["end"], None)
+    summary = summarize_versions(ol.cg)
+    sj = json.dumps(summary).encode("utf8")
+    t0 = _time.perf_counter()
+    sf = encode_frame(FRAME_SUMMARY, encode_summary(summary),
+                      compress=True)
+    t_senc = _time.perf_counter() - t0
+    if decode_summary(decode_frame(sf)[1]) != summary:
+        raise AssertionError("wire-bench: summary did not round-trip")
+    row["summary"] = {"agents": len(summary),
+                      "json_bytes": len(sj), "frame_bytes": len(sf),
+                      "ratio": round(len(sj) / max(len(sf), 1), 2),
+                      "encode_s": round(t_senc, 6)}
+
+    # ---- patch frame: the full encode under the lz4 envelope
+    patch = encode_oplog(ol, ENCODE_FULL)
+    pf = encode_frame(FRAME_PATCH, patch, compress=True)
+    row["patch"] = {"raw_bytes": len(patch), "frame_bytes": len(pf),
+                    "ratio": round(len(patch) / max(len(pf), 1), 2)}
+
+    # ---- docs listing frame: the steady-state anti-entropy preamble
+    listing = {"self": "127.0.0.1:8001", "docs": {
+        f"t{d % 4}-doc{d:03d}": {
+            "lease": {"holder": f"127.0.0.1:{8001 + d % 3}",
+                      "epoch": 1 + d % 5, "state": "active",
+                      "ttl_s": 0.9},
+            "frontier": [[f"t0s{d % agents}g{d % 7}", d]],
+        } for d in range(docs)}}
+    lj = json.dumps(listing).encode("utf8")
+    lf = encode_frame(FRAME_DOCS, encode_docs(listing), compress=True)
+    rt = decode_docs(decode_frame(lf)[1])
+    if rt["docs"] != listing["docs"] or rt["self"] != listing["self"]:
+        raise AssertionError("wire-bench: docs listing did not "
+                             "round-trip")
+    row["docs"] = {"n_docs": docs, "json_bytes": len(lj),
+                   "frame_bytes": len(lf),
+                   "ratio": round(len(lj) / max(len(lf), 1), 2)}
+    return row
+
+
+def cmd_wire_bench(args) -> int:
+    """Wire-frame codec micro-benchmark (see wire_bench)."""
+    row = wire_bench(seed=args.seed, n_ops=args.ops,
+                     agents=args.agents, docs=args.docs)
+    print(json.dumps(row, indent=1 if args.json else None))
+    return 0
+
+
 def cmd_dt_lint(args) -> int:
     """Concurrency invariant lint (analysis/): lock-order violations,
     unsorted multi-lock acquisition, device dispatch under the
@@ -1169,6 +1282,22 @@ def main(argv=None) -> int:
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
     c.set_defaults(fn=cmd_read_bench)
+
+    c = sub.add_parser(
+        "wire-bench",
+        help="wire-frame codec micro-benchmark: churn op tape through "
+        "each frame codec vs its JSON twin (throughput + wire-byte "
+        "ratios; the row bench.py ingests)")
+    c.add_argument("--seed", type=int, default=7)
+    c.add_argument("--ops", type=int, default=2000,
+                   help="length of the churn op tape")
+    c.add_argument("--agents", type=int, default=8,
+                   help="concurrently-churning agent names")
+    c.add_argument("--docs", type=int, default=64,
+                   help="doc count for the listing-frame measurement")
+    c.add_argument("--json", action="store_true",
+                   help="pretty-print the row")
+    c.set_defaults(fn=cmd_wire_bench)
 
     c = sub.add_parser(
         "dt-lint",

@@ -129,6 +129,13 @@ from ..encoding.decode import decode_into, load_oplog
 from ..encoding.encode import ENCODE_FULL, ENCODE_PATCH, encode_oplog
 from ..obs.trace import TRACE_HEADER, parse_header
 from ..text.oplog import OpLog
+from ..wire.frames import (FRAME_DOCS, FRAME_OPS, FRAME_PATCH,
+                           FRAME_SNAPSHOT, FRAME_STATE, FRAME_SUMMARY,
+                           WIRE_CTYPE, WIRE_HEADER, WireError,
+                           decode_frame, decode_ops, decode_records,
+                           decode_summary, encode_docs, encode_frame,
+                           encode_state, encode_summary, is_frame)
+from ..wire.snapshot import build_snapshot
 
 # Doc ids are filenames (DocStore writes {data_dir}/{id}.dt) and are
 # interpolated into the served pages: restrict to a safe charset.
@@ -617,6 +624,21 @@ class SyncHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _wire(self):
+        """This node's WireChannel, or None when replication is off
+        (single-server mode has no mesh transport to account)."""
+        node = self.store.replica
+        return node.wire if node is not None else None
+
+    def _wire_reply_ok(self) -> bool:
+        """May this response be a binary frame? Only when the REQUEST
+        advertised `X-DT-Wire` (so the caller decodes frames) AND this
+        node's framing is on — a node pinned to JSON behaves like an
+        old build end to end, though it still accepts inbound frames."""
+        w = self._wire()
+        return (w is not None and w.enabled
+                and self.headers.get(WIRE_HEADER) is not None)
+
     def _route(self):
         # query string stripped: GET doc endpoints take contract
         # params (?max_staleness=) that must not leak into the action
@@ -639,7 +661,8 @@ class SyncHandler(BaseHTTPRequestHandler):
             sub = parts[2] if len(parts) > 2 else "text"
             return "doc_" + (sub if sub in (
                 "summary", "state", "graph", "pull", "push", "edit",
-                "changes", "ops", "history", "at", "text") else "other")
+                "changes", "ops", "history", "at", "text",
+                "snapshot") else "other")
         if head in ("replicate", "debug") and len(parts) == 2:
             return f"{head}_{parts[1]}"
         if head == "debug" and len(parts) == 3 and parts[1] == "trace":
@@ -778,12 +801,28 @@ class SyncHandler(BaseHTTPRequestHandler):
             if node is None:
                 return self._send(404, b"{}")
             if len(parts) == 2 and parts[1] == "ping":
-                return self._send(200, json.dumps(node.ping_json())
-                                  .encode("utf8"))
+                body = json.dumps(node.ping_json()).encode("utf8")
+                # ping IS the gossip transport: its response bytes are
+                # the gossip channel's whole volume
+                node.wire.account("gossip", sent_bytes=len(body))
+                return self._send(200, body)
             if len(parts) == 2 and parts[1] == "docs":
-                # doc list + piggybacked lease claims (anti-entropy)
-                return self._send(200, json.dumps(node.docs_json())
-                                  .encode("utf8"))
+                # doc list + piggybacked lease claims + frontier
+                # adverts (anti-entropy round preamble). Re-sent every
+                # round, so once deltas stop flowing this listing IS
+                # the channel's steady-state cost — frame it.
+                listing = node.docs_json()
+                body = json.dumps(listing).encode("utf8")
+                if self._wire_reply_ok():
+                    frame = encode_frame(FRAME_DOCS,
+                                         encode_docs(listing),
+                                         compress=True)
+                    node.wire.account("antientropy",
+                                      sent_bytes=len(frame),
+                                      json_bytes=len(body), framed=True)
+                    return self._send(200, frame, WIRE_CTYPE)
+                node.wire.account("antientropy", sent_bytes=len(body))
+                return self._send(200, body)
             return self._send(404, b"{}")
         if len(parts) == 2 and parts[0] in ("edit", "vis", "crdt"):
             if not _DOC_ID_RE.match(parts[1]):
@@ -802,6 +841,10 @@ class SyncHandler(BaseHTTPRequestHandler):
         no_store = {"Cache-Control": "no-store"}
         if action in ("", "state") and self.store.reads is not None:
             return self._read_with_contract(doc_id, action, no_store)
+        if action == "snapshot":
+            # routed BEFORE store.get: 404ing a doc that was never
+            # materialized here must not mint an empty oplog for it
+            return self._doc_snapshot(doc_id, no_store)
         ol = self.store.get(doc_id)
         if action == "":
             with self.store.lock:
@@ -814,7 +857,18 @@ class SyncHandler(BaseHTTPRequestHandler):
                                      json.dumps(frontier)})
         if action == "summary":
             with self.store.lock:
-                body = json.dumps(summarize_versions(ol.cg)).encode("utf8")
+                summary = summarize_versions(ol.cg)
+            body = json.dumps(summary).encode("utf8")
+            w = self._wire()
+            if self._wire_reply_ok():
+                frame = encode_frame(FRAME_SUMMARY,
+                                     encode_summary(summary),
+                                     compress=True)
+                w.account("antientropy", sent_bytes=len(frame),
+                          json_bytes=len(body), framed=True)
+                return self._send(200, frame, WIRE_CTYPE, extra=no_store)
+            if w is not None:
+                w.account("antientropy", sent_bytes=len(body))
             return self._send(200, body, extra=no_store)
         if action == "state":
             with self.store.lock:
@@ -839,6 +893,31 @@ class SyncHandler(BaseHTTPRequestHandler):
             return self._send(200, json.dumps({"runs": runs}).encode("utf8"),
                               extra=no_store)
         return self._send(404, b"{}")
+
+    def _doc_snapshot(self, doc_id: str, no_store: dict):
+        """GET /doc/{id}/snapshot — compacted-snapshot frame for
+        far-behind peers and cold remote hydration fills. The frame is
+        cached per frontier in the node's WireChannel, so a thundering
+        herd of cold followers costs one encode. 404 when replication
+        or framing is off, or the doc isn't materialized here."""
+        node = self.store.replica
+        if node is None or not node.wire.enabled:
+            return self._send(404, b"{}")
+        with self.store.lock:
+            ol = self.store.docs.get(doc_id)
+            if ol is None:
+                return self._send(404, b"{}")
+            key = tuple(sorted(map(
+                tuple, ol.cg.local_to_remote_frontier(ol.version))))
+        hyd = getattr(self.store.scheduler, "hydrator", None)
+        tstore = getattr(hyd, "store", None)
+        frame = node.wire.cached_snapshot(
+            doc_id, key,
+            lambda: build_snapshot(ol, store=tstore, doc_id=doc_id,
+                                   oplog_lock=self.store.lock))
+        node.wire.account("hydrate", sent_bytes=len(frame),
+                          framed=True, snapshot=True)
+        return self._send(200, frame, WIRE_CTYPE, extra=no_store)
 
     def _read_with_contract(self, doc_id: str, action: str,
                             no_store: dict):
@@ -869,11 +948,37 @@ class SyncHandler(BaseHTTPRequestHandler):
             except (ValueError, TypeError):
                 return self._send(400, json.dumps(
                     {"error": "bad min_version token"}).encode("utf8"))
+        proxied = self.headers.get("X-DT-Proxied") is not None
         res = self.store.reads.read(
             doc_id, "text" if action == "" else "state",
             max_staleness=max_staleness, min_version=min_version,
-            forced_local=self.headers.get("X-DT-Proxied") is not None,
+            forced_local=proxied,
             trace=parse_header(self.headers.get(TRACE_HEADER)))
+        if proxied and action == "state" and res.status == 200:
+            # owner side of a follower's proxy hop: the mesh leg can be
+            # framed (the follower re-inflates JSON for its client);
+            # accounted here because this host sends the response bytes
+            w = self._wire()
+            if w is not None:
+                framed = False
+                send = res.body
+                if self._wire_reply_ok():
+                    try:
+                        state = json.loads(res.body)
+                        frame = encode_frame(
+                            FRAME_STATE,
+                            encode_state(state["text"], state["version"]),
+                            compress=True)
+                        if len(frame) < len(res.body):
+                            send, framed = frame, True
+                    except (ValueError, KeyError, TypeError):
+                        pass  # non-JSON body: fall through unframed
+                w.account("proxy", sent_bytes=len(send),
+                          json_bytes=len(res.body) if framed else None,
+                          framed=framed)
+                if framed:
+                    return self._send(200, send, WIRE_CTYPE,
+                                      extra={**no_store, **res.headers})
         return self._send(res.status, res.body, res.ctype,
                           extra={**no_store, **res.headers})
 
@@ -981,19 +1086,50 @@ class SyncHandler(BaseHTTPRequestHandler):
                         return self._send(status, resp)
         ol = self.store.get(doc_id)
         if action == "pull":
-            summary = json.loads(body or b"{}")
+            if is_frame(body):
+                ftype, payload = decode_frame(body)
+                if ftype != FRAME_SUMMARY:
+                    raise WireError("pull body: expected SUMMARY frame")
+                summary = decode_summary(payload)
+            else:
+                summary = json.loads(body or b"{}")
             with self.store.lock:
                 common, _rem = intersect_with_summary(ol.cg, summary)
                 patch = encode_oplog(ol, ENCODE_PATCH, from_version=common)
+            w = self._wire()
+            if self._wire_reply_ok():
+                frame = encode_frame(FRAME_PATCH, patch, compress=True)
+                if len(frame) < len(patch):
+                    w.account("antientropy", sent_bytes=len(frame),
+                              json_bytes=len(patch), framed=True)
+                    return self._send(200, frame, WIRE_CTYPE)
+            if w is not None:
+                w.account("antientropy", sent_bytes=len(patch))
             return self._send(200, patch, "application/octet-stream")
         if action == "push":
+            # wire frames unwrap FIRST: agent-name validation below must
+            # see the raw DMNDTYPS blob(s), not the frame envelope. A
+            # PATCH frame carries one patch; a SNAPSHOT frame carries a
+            # record list (compacted far-behind catch-up) replayed in
+            # order under the same lock.
+            blobs = [body]
+            if is_frame(body):
+                ftype, payload = decode_frame(body)
+                if ftype == FRAME_PATCH:
+                    blobs = [payload]
+                elif ftype == FRAME_SNAPSHOT:
+                    blobs = decode_records(payload)
+                else:
+                    raise WireError(
+                        "push body: expected PATCH or SNAPSHOT frame")
             # the binary path must enforce the same agent-name rules as
             # the JSON paths — a patch can register brand-new agents, and
             # an astral name would poison browser-vs-server convergence
             # for the whole doc (see _agent_name_ok)
             try:
-                bad = [n for n in _patch_agent_names(body)
-                       if not _agent_name_ok(n)]
+                bad = [nm for blob in blobs
+                       for nm in _patch_agent_names(blob)
+                       if not _agent_name_ok(nm)]
             except Exception:
                 return self._send(400, b'{"error": "bad patch"}')
             if bad:
@@ -1001,7 +1137,8 @@ class SyncHandler(BaseHTTPRequestHandler):
             with self.store.lock:
                 pre = list(ol.version)
                 pre_len = len(ol)
-                decode_into(ol, body)
+                for blob in blobs:
+                    decode_into(ol, blob)
                 n_new = len(ol) - pre_len
                 # Does folding the pushed ops into the pre-push document
                 # actually collide (concurrent inserts at one gap)?
@@ -1032,7 +1169,7 @@ class SyncHandler(BaseHTTPRequestHandler):
                     # (agent, seq), so identity is the first new agent.
                     # Anti-entropy patches are excluded: those edits'
                     # journeys live on their owner, not here.
-                    agents = _patch_agent_names(body)
+                    agents = _patch_agent_names(blobs[0])
                     obs.journey.begin(agents[0] if agents else None,
                                       None, doc=doc_id,
                                       trace=tctx.trace_id)
@@ -1040,7 +1177,13 @@ class SyncHandler(BaseHTTPRequestHandler):
             return self._send(200, json.dumps(
                 {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
-            req = json.loads(body)
+            if is_frame(body):
+                ftype, payload = decode_frame(body)
+                if ftype != FRAME_OPS:
+                    raise WireError("edit body: expected OPS frame")
+                req = decode_ops(payload)
+            else:
+                req = json.loads(body)
             # Normalize each op ONCE (ints coerced exactly once, via
             # operator.index so floats like 3.7 are rejected, not
             # truncated) and use the normalized list for BOTH validation

@@ -313,6 +313,18 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         slo_warning += v["warning"]
     lag = {addrs[i]: n.obs.journey.lag_summary()
            for i, n in enumerate(nodes)}
+    # wire transport: per-channel counters summed across the mesh (every
+    # host accounts the bytes IT sends, so the sum is total transport);
+    # single-server runs have no mesh and omit the block entirely
+    wire: Optional[Dict[str, Dict[str, float]]] = None
+    if nodes:
+        from ..wire.frames import WIRE_CHANNELS, WIRE_KEYS
+        wire = {ch: {k: 0 for k in WIRE_KEYS} for ch in WIRE_CHANNELS}
+        for node in nodes:
+            flat = node.metrics.wire_counters()
+            for ch in WIRE_CHANNELS:
+                for k in WIRE_KEYS:
+                    wire[ch][k] += flat[f"{ch}_{k}"]
     per_server = [{
         "addr": addrs[i],
         "flush_p99_s": (serve_snaps[i]["latencies"]["flush"]["p99"]
@@ -342,6 +354,7 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                      "reconcile_rounds": converged_after,
                      "lag": lag},
         hydration=hydration,
+        wire=wire,
         per_server=per_server,
         ok=ok,
         extra={"session_churns": session_churns,

@@ -105,6 +105,24 @@ register(Scenario(
           "edits_per_round": 32},
 ))
 
+# Mesh-transport stressor: a 3-server mesh with aggressive session
+# churn and a modest doc set, so anti-entropy re-walks the same docs
+# round after round and most edits land on non-owners (proxied). This
+# is the wire tier's before/after scenario — run once with
+# DT_WIRE_DISABLED=1 (JSON protocol, no frontier short-circuit) and
+# once framed, then scorecard-diff the wire.* bytes_per_op columns.
+register(Scenario(
+    name="churn",
+    description="session-churn mesh traffic: the wire-tier transport "
+                "baseline (antientropy + proxy bytes_per_op)",
+    seed=17, servers=3, serve_shards=1, tenants=2, docs_per_tenant=8,
+    duration_s=8.0, tick_s=0.25,
+    arrivals={"kind": "poisson", "rate_per_s": 10.0},
+    popularity={"kind": "zipf", "s": 1.3},
+    reads_per_write=6.0,
+    sessions_per_tenant=3, session_churn_every_s=1.5,
+))
+
 register(Scenario(
     name="flash-crowd",
     description="bursty arrivals on a rotating hot set: the admission/"

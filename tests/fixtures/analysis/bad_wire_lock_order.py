@@ -1,0 +1,15 @@
+"""Seeded dt-lint fixture: wire frame-cache lock-order violation.
+
+Acquires the WireChannel's snapshot-frame cache guard (io, 25) while
+already holding the oplog guard (30) — backwards against the canonical
+order: frame builds take the oplog guard strictly OUTSIDE the cache
+lock (a racing pair builds twice, caches once), never the reverse.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureWireChannel:
+    def backwards(self, doc_id, key):
+        with self.store.lock:
+            with self._frame_cache_lock:
+                return self._frames.get((doc_id, key))

@@ -56,6 +56,7 @@ def _witness_clean():
     ("bad_read_lock_order.py", "lock-order", 15, "error"),
     ("bad_rebalance_lock_order.py", "lock-order", 14, "error"),
     ("bad_ts_lock_order.py", "lock-order", 15, "error"),
+    ("bad_wire_lock_order.py", "lock-order", 14, "error"),
     ("bad_xform_lock_order.py", "lock-order", 15, "error"),
     ("bad_unsorted_locks.py", "unsorted-locks", 15, "error"),
     ("bad_device_under_lock.py", "device-under-lock", 13, "error"),

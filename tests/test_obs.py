@@ -284,7 +284,7 @@ def test_replication_metrics_v3_derived_keys():
     for s in (0.1, 0.3):
         rm.observe_handoff_latency(s)
     snap = rm.snapshot()
-    assert snap["version"] == 6
+    assert snap["version"] == 7
     assert snap["latencies"]["handoff"]["count"] == 2
     assert snap["handoffs"]["latency_s_total"] == pytest.approx(0.4)
     assert snap["handoffs"]["latency_s_max"] == pytest.approx(0.3)
@@ -419,7 +419,7 @@ def test_metrics_endpoint_formats_and_debug_events():
             assert r.headers["Content-Type"].startswith(
                 "application/json")
             doc = json.loads(r.read())
-        assert doc["serve"]["version"] == 11
+        assert doc["serve"]["version"] == 12
         assert doc["serve"]["latencies"]["flush"]["count"] >= 1
         assert doc["obs"]["trace"]["started"] >= 1
         assert any(row["count"] >= 1

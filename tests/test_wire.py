@@ -1,0 +1,358 @@
+"""Wire-tier tests (diamond_types_tpu/wire/): envelope fuzzing
+(truncation + bit flips must raise a framed decode error, never yield
+garbage ops), payload codec round-trips for every frame type including
+unicode-heavy op tapes, snapshot build/apply idempotence, and channel
+negotiation/accounting. Pure host-side, tier-1 safe."""
+
+import json
+import random
+
+import pytest
+
+from diamond_types_tpu.replicate.metrics import ReplicationMetrics
+from diamond_types_tpu.text.oplog import OpLog
+from diamond_types_tpu.wire.channel import WireChannel, wire_enabled
+from diamond_types_tpu.wire.frames import (FRAME_DOCS, FRAME_OPS,
+                                           FRAME_PATCH, FRAME_SNAPSHOT,
+                                           FRAME_STATE, FRAME_SUMMARY,
+                                           MAGIC, WIRE_CHANNELS,
+                                           WIRE_KEYS, WireError,
+                                           decode_docs, decode_frame,
+                                           decode_ops, decode_records,
+                                           decode_state, decode_summary,
+                                           encode_docs, encode_frame,
+                                           encode_ops, encode_records,
+                                           encode_state, encode_summary,
+                                           is_frame)
+from diamond_types_tpu.wire.snapshot import (apply_snapshot,
+                                             build_snapshot, missing_ops,
+                                             should_ship_snapshot)
+
+pytestmark = pytest.mark.wire
+
+# astral plane, combining accent, CJK, latin-1 supplement — every op
+# tape below draws from this so utf8 length != codepoint count
+_ALPHABET = "etaoin shrdluéß世界\U0001f600é"
+
+
+def _random_tape(rng, n_ops):
+    """A plausible churn tape: interleaved unicode inserts and deletes
+    against a tracked doc length (the shape the proxy channel ships)."""
+    ops, doc_len = [], 0
+    for _ in range(n_ops):
+        if doc_len > 4 and rng.random() < 0.35:
+            start = rng.randrange(doc_len)
+            end = min(doc_len, start + 1 + rng.randrange(6))
+            ops.append({"kind": "del", "start": start, "end": end})
+            doc_len -= end - start
+        else:
+            text = "".join(rng.choice(_ALPHABET)
+                           for _ in range(rng.randrange(1, 9)))
+            pos = rng.randrange(doc_len + 1)
+            ops.append({"kind": "ins", "pos": pos, "text": text})
+            doc_len += len(text)
+    return ops
+
+
+def _random_req(rng, n_ops=12):
+    agent = f"t{rng.randrange(3)}s{rng.randrange(9)}"
+    return {"agent": agent,
+            "version": [[agent, rng.randrange(1000)],
+                        [f"peer{rng.randrange(4)}", rng.randrange(50)]],
+            "ops": _random_tape(rng, n_ops)}
+
+
+# ---- envelope --------------------------------------------------------------
+
+def test_envelope_roundtrip_every_type():
+    rng = random.Random(1)
+    for ftype in (FRAME_SUMMARY, FRAME_PATCH, FRAME_OPS, FRAME_STATE,
+                  FRAME_SNAPSHOT, FRAME_DOCS):
+        for size in (0, 1, 63, 64, 65, 900):
+            payload = bytes(rng.randrange(7) for _ in range(size))
+            for compress in (False, True):
+                frame = encode_frame(ftype, payload, compress=compress)
+                assert is_frame(frame)
+                assert decode_frame(frame) == (ftype, payload)
+
+
+def test_envelope_compression_keeps_smaller_only():
+    # low-entropy payload compresses; the frame must round-trip AND
+    # actually come out smaller than the raw framing
+    payload = b"abababab" * 200
+    small = encode_frame(FRAME_PATCH, payload, compress=True)
+    raw = encode_frame(FRAME_PATCH, payload, compress=False)
+    assert len(small) < len(raw)
+    assert decode_frame(small) == (FRAME_PATCH, payload)
+    # tiny payloads are never compressed (the <=64 byte floor)
+    tiny = encode_frame(FRAME_PATCH, b"ab" * 8, compress=True)
+    assert decode_frame(tiny) == (FRAME_PATCH, b"ab" * 8)
+
+
+def test_envelope_rejects_version_type_and_flags():
+    frame = bytearray(encode_frame(FRAME_OPS, b"x" * 20))
+    bad_version = bytes(frame[:4]) + b"\x02" + bytes(frame[5:])
+    with pytest.raises(WireError):
+        decode_frame(bad_version)
+    bad_type = bytes(frame[:5]) + b"\x63" + bytes(frame[6:])
+    with pytest.raises(WireError):
+        decode_frame(bad_type)
+    bad_flags = bytes(frame[:6]) + b"\x40" + bytes(frame[7:])
+    with pytest.raises(WireError):
+        decode_frame(bad_flags)
+    with pytest.raises(WireError):
+        decode_frame(b"JSON" + bytes(frame[4:]))   # not our magic
+    with pytest.raises(WireError):
+        decode_frame(MAGIC)                        # shorter than a header
+
+
+def test_fuzz_truncation_always_raises():
+    """Every strict prefix of a valid frame is a framed decode error —
+    a cut-off transfer can never decode into ops."""
+    rng = random.Random(2)
+    for _ in range(8):
+        req = _random_req(rng)
+        frame = encode_frame(FRAME_OPS, encode_ops(req), compress=True)
+        assert decode_ops(decode_frame(frame)[1]) == req
+        for cut in range(len(frame)):
+            with pytest.raises(WireError):
+                decode_frame(frame[:cut])
+
+
+def test_fuzz_bitflip_always_raises():
+    """Flipping any single bit anywhere in a frame (magic, header,
+    length, payload, crc) must surface as WireError: the crc catches
+    payload damage, explicit checks catch header damage. Corruption
+    never decodes into garbage ops."""
+    rng = random.Random(3)
+    for compress in (False, True):
+        req = _random_req(rng, n_ops=20)
+        frame = encode_frame(FRAME_OPS, encode_ops(req),
+                             compress=compress)
+        for i in range(len(frame)):
+            mutated = bytearray(frame)
+            mutated[i] ^= 1 << rng.randrange(8)
+            with pytest.raises(WireError):
+                decode_frame(bytes(mutated))
+
+
+def test_fuzz_random_junk_never_decodes():
+    rng = random.Random(4)
+    for n in (0, 3, 11, 12, 40, 300):
+        junk = bytes(rng.randrange(256) for _ in range(n))
+        with pytest.raises(WireError):
+            decode_frame(junk)
+        with pytest.raises(WireError):
+            decode_frame(MAGIC + junk)
+
+
+# ---- payload codecs --------------------------------------------------------
+
+def test_ops_tape_roundtrip_fuzz():
+    """Random unicode op tapes round-trip exactly: decoded dict equals
+    the input, and re-encoding is byte-identical (canonical form)."""
+    rng = random.Random(5)
+    for _ in range(40):
+        req = _random_req(rng, n_ops=rng.randrange(0, 30))
+        payload = encode_ops(req)
+        out = decode_ops(payload)
+        assert out == req
+        assert encode_ops(out) == payload
+    with pytest.raises(WireError):
+        encode_ops({"agent": "a", "version": [],
+                    "ops": [{"kind": "mv", "pos": 0}]})
+    with pytest.raises(WireError):
+        decode_ops(encode_ops(_random_req(rng)) + b"\x00")
+
+
+def test_summary_roundtrip_and_wins_over_json():
+    rng = random.Random(6)
+    summary = {}
+    for a in range(12):
+        runs, prev = [], 0
+        for _ in range(rng.randrange(1, 5)):
+            s = prev + rng.randrange(0, 40)
+            e = s + 1 + rng.randrange(200)
+            runs.append([s, e])
+            prev = e
+        summary[f"tenant{a % 3}-sess{a}"] = runs
+    payload = encode_summary(summary)
+    assert decode_summary(payload) == summary
+    assert len(payload) < len(json.dumps(summary).encode("utf8"))
+    with pytest.raises(WireError):
+        decode_summary(payload + b"\x01")
+
+
+def test_state_roundtrip_unicode():
+    text = "héllo 世界 \U0001f600" * 40
+    version = [["alice", 7], ["bøb", 123456]]
+    payload = encode_state(text, version)
+    assert decode_state(payload) == (text, version)
+    with pytest.raises(WireError):
+        decode_state(payload + b"\x00")
+
+
+def test_docs_roundtrip_with_leases_and_frontiers():
+    listing = {
+        "self": "127.0.0.1:9001",
+        "docs": {
+            "t0-doc001": {"lease": {"holder": "127.0.0.1:9002",
+                                    "epoch": 4, "state": "active",
+                                    "ttl_s": 0.9},
+                          "frontier": [["alice", 10], ["bob", 3]]},
+            "t0-doc002": {"lease": {"holder": "127.0.0.1:9002",
+                                    "epoch": 9, "state": "granted",
+                                    "ttl_s": 1.5},
+                          "frontier": []},
+            "t1-doc000": {"lease": None,
+                          "frontier": [["céline", 2]]},
+            "t1-doc001": {"lease": None},   # no frontier advertised
+        },
+    }
+    out = decode_docs(encode_docs(listing))
+    assert out["self"] == listing["self"]
+    assert set(out["docs"]) == set(listing["docs"])
+    d1 = out["docs"]["t0-doc001"]
+    assert d1["lease"] == listing["docs"]["t0-doc001"]["lease"]
+    assert d1["frontier"] == [["alice", 10], ["bob", 3]]
+    assert out["docs"]["t1-doc000"]["lease"] is None
+    assert "frontier" not in out["docs"]["t1-doc001"]
+    # negative ttl clamps to zero rather than wrapping the varint
+    neg = {"self": "s", "docs": {"d": {"lease": {
+        "holder": "h", "epoch": 1, "state": "active", "ttl_s": -3.0}}}}
+    assert decode_docs(encode_docs(neg))["docs"]["d"]["lease"]["ttl_s"] == 0.0
+
+
+def test_docs_rejects_unknown_flags():
+    # single doc, no lease, no frontier: the flags byte is last
+    payload = bytearray(encode_docs({"self": "s", "docs": {"d": {}}}))
+    assert payload[-1] == 0
+    payload[-1] = 0x80
+    with pytest.raises(WireError):
+        decode_docs(bytes(payload))
+    with pytest.raises(WireError):
+        decode_docs(bytes(payload[:-1]))       # truncated doc entry
+
+
+def test_records_roundtrip_and_truncation():
+    records = [b"DMNDTYPS" + bytes(range(50)), b"", b"\x00" * 9]
+    payload = encode_records(records)
+    assert decode_records(payload) == records
+    with pytest.raises(WireError):
+        decode_records(payload[:-3])
+    with pytest.raises(WireError):
+        decode_records(payload + b"\x00")
+
+
+# ---- snapshot shipping -----------------------------------------------------
+
+def _seed_oplog(text="snapshot shipping"):
+    ol = OpLog()
+    a = ol.get_or_create_agent_id("alice")
+    for i, ch in enumerate(text):
+        ol.add_insert(a, i, ch)
+    return ol
+
+
+def test_snapshot_build_apply_idempotent():
+    ol = _seed_oplog()
+    frame = build_snapshot(ol)
+    assert is_frame(frame)
+    ol2 = OpLog()
+    merged = apply_snapshot(ol2, frame)
+    assert merged == len(ol)
+    assert ol2.checkout_tip().snapshot() == ol.checkout_tip().snapshot()
+    # double delivery merges to the same bytes (dedup-safe replay)
+    assert apply_snapshot(ol2, frame) == 0
+    assert ol2.checkout_tip().snapshot() == ol.checkout_tip().snapshot()
+
+
+def test_apply_snapshot_rejects_wrong_frame_type():
+    with pytest.raises(WireError):
+        apply_snapshot(OpLog(), encode_frame(FRAME_PATCH, b"nope"))
+    with pytest.raises(WireError):
+        apply_snapshot(OpLog(), b"not a frame at all")
+
+
+def test_should_ship_snapshot_threshold():
+    ol = _seed_oplog("0123456789")
+    assert missing_ops(ol.cg, ol.version, []) == len(ol)
+    assert should_ship_snapshot(ol.cg, ol.version, [], threshold=4)
+    assert not should_ship_snapshot(ol.cg, ol.version, [], threshold=10)
+    assert not should_ship_snapshot(ol.cg, ol.version, [], threshold=0)
+    # peer already at tip: nothing missing, never ship
+    assert not should_ship_snapshot(ol.cg, ol.version, list(ol.version),
+                                    threshold=1)
+
+
+# ---- channel: negotiation, accounting, frame cache -------------------------
+
+def test_channel_negotiation_and_fallback():
+    ch = WireChannel(enabled=True)
+    assert ch.header_value() == "v1"
+    assert not ch.use_wire("peer")          # unknown peer: JSON fallback
+    ch.note_peer("peer", 1)
+    assert ch.use_wire("peer")
+    ch.note_peer("old", None)               # pre-wire build gossips nothing
+    assert not ch.use_wire("old")
+    ch.note_peer("weird", "bogus")
+    assert not ch.use_wire("weird")
+    off = WireChannel(enabled=False)
+    off.note_peer("peer", 1)
+    assert off.header_value() is None and not off.use_wire("peer")
+
+
+def test_wire_enabled_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("DT_WIRE_DISABLED", "1")
+    assert not wire_enabled()
+    assert not WireChannel().enabled        # default follows the env
+    monkeypatch.setenv("DT_WIRE_DISABLED", "0")
+    assert wire_enabled()
+    monkeypatch.delenv("DT_WIRE_DISABLED")
+    assert wire_enabled()
+
+
+def test_channel_accounting_lands_in_metrics():
+    m = ReplicationMetrics()
+    ch = WireChannel(metrics=m, enabled=True)
+    ch.account("proxy", sent_bytes=10, json_bytes=30, framed=True)
+    ch.account("proxy", sent_bytes=50)      # JSON fallback: bytes only
+    ch.account("hydrate", sent_bytes=5, framed=True, snapshot=True)
+    # a frame that did NOT beat JSON never counts negative savings
+    ch.account("antientropy", sent_bytes=40, json_bytes=40, framed=True)
+    w = m.wire_counters()
+    assert w["proxy_bytes_sent"] == 60
+    assert w["proxy_bytes_saved"] == 20
+    assert w["proxy_frames"] == 1
+    assert w["hydrate_frames"] == 1
+    assert w["hydrate_snapshot_ships"] == 1
+    assert w["antientropy_bytes_saved"] == 0
+    assert set(w) == {f"{c}_{k}" for c in WIRE_CHANNELS
+                      for k in WIRE_KEYS}
+    # the snapshot embeds the flat wire group for the scorecard
+    assert m.snapshot()["wire"]["gossip_bytes_sent"] == 0
+    # metricsless channel still answers counters() with zeros
+    assert WireChannel().counters()["proxy_frames"] == 0
+
+
+def test_frame_cache_reuse_invalidate_evict():
+    ch = WireChannel(enabled=True, cache_entries=2)
+    builds = []
+
+    def builder(tag):
+        def build():
+            builds.append(tag)
+            return f"frame:{tag}".encode("utf8")
+        return build
+
+    key = (("alice", 3),)
+    assert ch.cached_snapshot("d1", key, builder("a")) == b"frame:a"
+    assert ch.cached_snapshot("d1", key, builder("a2")) == b"frame:a"
+    assert builds == ["a"]                  # second hit served cached
+    ch.invalidate("d1")
+    assert ch.cached_snapshot("d1", key, builder("a3")) == b"frame:a3"
+    # eviction: cache holds 2 entries, the oldest falls out
+    ch.cached_snapshot("d2", key, builder("b"))
+    ch.cached_snapshot("d3", key, builder("c"))
+    ch.cached_snapshot("d1", key, builder("a4"))
+    assert builds == ["a", "a3", "b", "c", "a4"]
