@@ -1715,6 +1715,38 @@ def _main() -> None:
     except Exception as e:  # pragma: no cover
         extra["scenario_smoke_error"] = str(e)[:120]
 
+    # Adaptive-admission A/B (qos/ tier): the same smoke scenario with
+    # the closed-loop QoS controller attached, diffed in-process
+    # against the static-admission card above at equal parity (same
+    # seed, same tape). The summary keeps the one-diff gate verdict
+    # plus the controller's decision mix — shed counts on a healthy
+    # run must be zero.
+    try:
+        from diamond_types_tpu.obs.scorecard import diff_scorecards
+        from diamond_types_tpu.workload import (get_scenario,
+                                                run_scenario)
+        control = full.get("scenario_smoke") \
+            or run_scenario(get_scenario("smoke"))
+        adaptive = run_scenario(get_scenario("smoke"), qos=True)
+        diff = diff_scorecards(control, adaptive)
+        full["qos_ab"] = {"control": control, "adaptive": adaptive,
+                          "diff": diff}
+        qblock = adaptive.get("qos") or {}
+        extra["qos_ab"] = {
+            "gate_ok": diff["ok"],
+            "regressions": diff["regressions"],
+            "ops_per_sec": adaptive["throughput"]["ops_per_s"],
+            "control_ops_per_sec": control["throughput"]["ops_per_s"],
+            "flush_p99_s": adaptive["latency_p99_s"]["flush"],
+            "control_flush_p99_s": control["latency_p99_s"]["flush"],
+            "admitted": {c: row.get("admitted", 0) for c, row in
+                         (qblock.get("classes") or {}).items()},
+            "sheds": qblock.get("sheds_observed"),
+            "controller": qblock.get("controller"),
+        }
+    except Exception as e:  # pragma: no cover
+        extra["qos_ab_error"] = str(e)[:120]
+
     # Peak-memory probe (reference: examples/posstats.rs behind the
     # memusage feature / trace-alloc counting allocator). Python-side
     # allocations only; the C++ tier's tables are outside tracemalloc.

@@ -49,7 +49,8 @@ from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Set
 
 DEFAULT_PACKAGES = ("serve", "replicate", "tpu", "parallel", "tools",
-                    "storage", "read", "obs", "workload", "wire")
+                    "storage", "read", "obs", "workload", "wire",
+                    "qos")
 
 SEVERITY = {
     "lock-order": "error",

@@ -9,8 +9,14 @@ Canonical order (must only ever grow rightward while locks are held):
 
   repl.maintain(0) -> repl.rebalance(1) -> repl.leases(2) ->
   repl.membership(3) -> repl.peers(4) -> repl.quorum(5) ->
-  global(10) -> shard(20) -> io(25) -> oplog(30) -> device(40) ->
-  leaf(50)
+  qos(8) -> global(10) -> shard(20) -> io(25) -> oplog(30) ->
+  device(40) -> leaf(50)
+
+(`qos` is the adaptive-admission controller's rung, deliberately
+OUTER to the scheduler's global lock: the control loop takes qos then
+global to read queue fills, while the hot admission path under global
+reads the published deadline table lock-free — code under global must
+never take the qos lock.)
 
 (`repl.rebalance` is the elastic-mesh planning rung: the rebalancer
 plans migrations under it and may then take lease state, but lease
@@ -45,6 +51,7 @@ ORDER_LEVELS = {
     "repl.membership": 3,
     "repl.peers": 4,
     "repl.quorum": 5,
+    "qos": 8,
     "global": 10,
     "shard": 20,
     "io": 25,
@@ -87,6 +94,11 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     if "_sync_lock" in src or "oplog_lock" in src or src == "olock" \
             or src.endswith("store.lock") or src == "store.lock":
         return "oplog"
+    # adaptive admission: the controller's rung sits between the
+    # replication plane and the scheduler global lock (step() takes
+    # qos -> global to read queue fills; the hot path never takes it)
+    if "_qos_lock" in src:
+        return "qos"
     if "_maintain_lock" in src:
         return "repl.maintain"
     # elastic mesh: the rebalancer's planning guard and the placement

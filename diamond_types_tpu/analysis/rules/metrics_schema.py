@@ -29,6 +29,11 @@ copied, so the rule can never drift from the schema itself):
                                pass a wire accounting keyword are
                                matched — `.account` alone is too
                                generic a method name)
+  .bump_class("cls", "key")    cls in qos.classes.QOS_CLASSES and key
+                               in qos.metrics.QOS_CLASS_KEYS (the
+                               dt_qos_*{class} prom families zero-fill
+                               from those same tuples)
+  .bump_ctl("key")             key in qos.metrics.QOS_CTL_KEYS
 
 plus the exemplar join: a module defining `_EXEMPLAR_FAMILIES` (the
 prom histogram -> TimeSeries mapping) must only name families some
@@ -48,6 +53,8 @@ import ast
 from typing import List, Optional
 
 from ..lint import FileContext, Violation
+from ...qos.classes import QOS_CLASSES
+from ...qos.metrics import QOS_CLASS_KEYS, QOS_CTL_KEYS
 from ...read.metrics import READ_KEYS
 from ...replicate.metrics import _GROUPS, _LATENCY_NAMES
 from ...serve.metrics import HYDRATION_KEYS, _SHARD_KEYS
@@ -138,6 +145,27 @@ def check_metrics_schema(ctx: FileContext, summary) -> List[Violation]:
                             f"wire channel {a0!r} is not in "
                             f"wire.frames.WIRE_CHANNELS "
                             f"{WIRE_CHANNELS}")
+            elif name == "bump_class" and args:
+                a0 = _const_str(args[0])
+                a1 = _const_str(args[1]) if len(args) > 1 else None
+                if a0 is not None and a0 not in QOS_CLASSES:
+                    violate(node.lineno,
+                            f"qos class {a0!r} is not in "
+                            f"qos.classes.QOS_CLASSES {QOS_CLASSES} — "
+                            f"the dt_qos_* prom families zero-fill "
+                            f"only the declared taxonomy")
+                if a1 is not None and a1 not in QOS_CLASS_KEYS:
+                    violate(node.lineno,
+                            f"qos counter {a1!r} is not in "
+                            f"qos.metrics.QOS_CLASS_KEYS "
+                            f"{QOS_CLASS_KEYS}")
+            elif name == "bump_ctl" and args:
+                a0 = _const_str(args[0])
+                if a0 is not None and a0 not in QOS_CTL_KEYS:
+                    violate(node.lineno,
+                            f"qos controller decision {a0!r} is not "
+                            f"in qos.metrics.QOS_CTL_KEYS "
+                            f"{QOS_CTL_KEYS}")
             elif name == "record_hydration" and args:
                 a0 = _const_str(args[0])
                 if a0 is not None and a0 not in HYDRATION_KEYS:

@@ -16,6 +16,11 @@ Naming scheme:
   dt_wire_<key>_total{channel}        wire-tier transport accounting
                                       (bytes_sent, bytes_saved, frames,
                                       snapshot_ships per channel)
+  dt_qos_<key>_total{class}           adaptive-admission per-class
+                                      counters (admitted/shed/deferred,
+                                      zero-filled over the class
+                                      taxonomy) + the effective-deadline
+                                      gauge and controller decisions
   dt_read_<counter>_total             follower-read tier counters
   dt_read_local_ratio /               local-serve ratio gauge +
   dt_read_staleness_seconds           staleness histogram
@@ -234,6 +239,40 @@ def _render_serve(b: _Builder, serve: dict) -> None:
                   row["device_sync_s"], labels=lb)
     for name, snap in sorted((serve.get("latencies") or {}).items()):
         b.histogram(f"dt_{name}_latency_seconds", snap)
+
+
+def _render_qos(b: _Builder, qos: dict) -> None:
+    """The adaptive-admission block (QosController.export / the
+    scorecard `qos` block). Zero-filled over QOS_CLASSES x
+    QOS_CLASS_KEYS and QOS_CTL_KEYS (the HYDRATION_KEYS idiom): an
+    idle controller still exports every series, so scrapers never see
+    a class flicker into existence on its first shed."""
+    from ..qos.classes import QOS_CLASSES
+    from ..qos.metrics import QOS_CLASS_KEYS, QOS_CTL_KEYS
+    b.add("dt_qos_enabled", "gauge", 1 if qos.get("enabled") else 0)
+    classes = qos.get("classes") or {}
+    names = sorted(set(QOS_CLASSES) | set(classes))
+    for key in QOS_CLASS_KEYS:
+        for cls in names:
+            b.add(f"dt_qos_{key}_total", "counter",
+                  (classes.get(cls) or {}).get(key, 0),
+                  labels={"class": cls})
+    for cls in names:
+        b.add("dt_qos_deadline_seconds", "gauge",
+              (classes.get(cls) or {}).get("deadline_s", 0.0),
+              labels={"class": cls})
+    ctl = qos.get("controller") or {}
+    for key in QOS_CTL_KEYS:
+        b.add("dt_qos_controller_total", "counter", ctl.get(key, 0),
+              labels={"decision": key})
+    shed = qos.get("shed") or {}
+    if shed:
+        b.add("dt_qos_mesh_state", "gauge",
+              _SLO_STATE_CODE.get(shed.get("mesh_state", "ok"), 0))
+        b.add("dt_qos_hot_tenants", "gauge",
+              len(shed.get("hot_tenants") or []))
+        b.add("dt_qos_retry_after_seconds", "gauge",
+              shed.get("retry_after_s", 0.0))
 
 
 def _render_read(b: _Builder, read: dict) -> None:
@@ -483,6 +522,12 @@ def render_metrics(doc: dict, openmetrics: bool = False) -> str:
     serve = doc.get("serve")
     if isinstance(serve, dict):
         _render_serve(b, serve)
+    # adaptive admission: the qos block rides top-level in the /metrics
+    # document (None/absent when no controller is attached — families
+    # omitted entirely, like the wire block on a meshless server)
+    qos = doc.get("qos")
+    if isinstance(qos, dict):
+        _render_qos(b, qos)
     # the read block rides either at top level (scheduler-less
     # servers) or inside the serve snapshot (ServeMetrics v8); render
     # whichever is present, once. A serving process with no read tier

@@ -58,6 +58,7 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
                     wire: Optional[Dict[str, Dict[str, float]]] = None,
                     per_server: Optional[List[dict]] = None,
                     ok: bool = True,
+                    qos: Optional[dict] = None,
                     extra: Optional[dict] = None) -> dict:
     """Assemble the stable scorecard document. Derived ratios
     (throughput, bytes/op) are computed here so every producer agrees
@@ -107,6 +108,11 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
             }
             for ch, vals in sorted(wire.items())
         }
+    if qos is not None:
+        # adaptive-admission block (merged QosMetrics snapshot). Absent
+        # on static-admission runs so pre-QoS baselines diff clean; no
+        # band gates on it — shed counts are policy, not regressions.
+        card["qos"] = dict(qos)
     if latencies is not None:
         card["latencies"] = latencies
     if per_server is not None:

@@ -271,15 +271,21 @@ class ReplicaNode:
 
     def proxy(self, target: str, path: str, body: bytes,
               doc_id: Optional[str] = None,
-              trace=None) -> Optional[Tuple[int, bytes]]:
+              trace=None,
+              qos: Optional[str] = None) -> Optional[Tuple[int, bytes]]:
         """Forward a mutation to its owner, stamping the lease epoch we
         routed by (the fencing token). Returns (status, body) to relay,
         or None when the caller should accept locally instead: target
         unreachable, or target fenced the epoch (our routing info was
         stale — anti-entropy reconciles once the new lease propagates).
         `trace` (obs SpanContext of the local HTTP span) rides the
-        X-DT-Trace header so the owner's handling joins the trace."""
+        X-DT-Trace header so the owner's handling joins the trace;
+        `qos` rides X-DT-QoS so the owner admits the work under the
+        class the edge classified (a proxied hop must not be
+        re-classified as replication traffic)."""
         headers = {"X-DT-Proxied": "1"}
+        if qos is not None:
+            headers["X-DT-QoS"] = qos
         if doc_id is not None:
             lease = self.leases.get(doc_id)
             if lease is not None and lease.holder == target:

@@ -20,12 +20,23 @@ Re-submitting a doc that is already queued never adds depth: the
 pending entry coalesces (its op count accumulates; it may migrate to a
 larger shape bucket; its deadline clock keeps the ORIGINAL enqueue time
 so coalescing cannot starve the deadline trigger).
+
+QoS (qos/): every item carries a class (interactive/bulk/catchup).
+With a controller attached (`self.qos`, set by MergeScheduler.
+attach_qos) the deadline trigger consults the controller's published
+per-(shard, class) effective deadline instead of the static
+`flush_deadline_s`, and each class is additionally bounded to its own
+depth budget (a fraction of `max_pending`). With no controller the
+static trigger runs byte-identically to before — the qos field rides
+along inert.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
+
+from ..qos.classes import QOS_PRIORITY
 
 
 def shape_bucket(n_ops: int) -> int:
@@ -51,6 +62,10 @@ class PendingMerge:
     # (None when unsampled/untraced) — lets the flush span parent on
     # the originating edit's trace
     trace: object = None
+    # QoS class the work was admitted under (qos/classes.py); decides
+    # which effective deadline the bucket's trigger consults when a
+    # controller is attached
+    qos: str = "interactive"
 
 
 class Backpressure(Exception):
@@ -79,6 +94,13 @@ class AdmissionQueue:
         self._q: List[Dict[int, Dict[str, PendingMerge]]] = [
             {} for _ in range(n_shards)]
         self._where: List[Dict[str, int]] = [{} for _ in range(n_shards)]
+        # qos.QosController (or None = static trigger). Set by
+        # MergeScheduler.attach_qos; read lock-free on the hot path.
+        self.qos = None
+        # shard -> class -> pending-doc count (per-class depth budgets;
+        # maintained unconditionally, enforced only with a controller)
+        self._class_depth: List[Dict[str, int]] = [
+            {} for _ in range(n_shards)]
 
     # ---- intake ----------------------------------------------------------
 
@@ -92,15 +114,36 @@ class AdmissionQueue:
     def total_depth(self) -> int:
         return sum(len(w) for w in self._where)
 
+    def class_depth(self, shard: int, qos: str) -> int:
+        return self._class_depth[shard].get(qos, 0)
+
+    def bucket_fill(self, shard: int) -> int:
+        """Doc count of the shard's fullest shape bucket (0 = empty) —
+        the controller's occupancy-gap input. Call under the same lock
+        that guards submit/take (the scheduler's global lock)."""
+        docs = self._q[shard]
+        return max((len(d) for d in docs.values()), default=0)
+
+    def _deadline_for(self, shard: int, qos: str) -> float:
+        ctl = self.qos
+        if ctl is None:
+            return self.flush_deadline_s
+        return ctl.effective_deadline(shard, qos)
+
     def submit(self, shard: int, doc_id: str, n_ops: int,
-               now: float, epoch: int = -1, trace=None) -> int:
+               now: float, epoch: int = -1, trace=None,
+               qos: str = "interactive") -> int:
         """Queue (or coalesce) `n_ops` of pending merge work for
         `doc_id`. Returns the shape bucket it landed in. Raises
-        Backpressure instead of exceeding `max_pending` docs/shard.
+        Backpressure instead of exceeding `max_pending` docs/shard (or,
+        with a controller attached, the class's own depth budget).
         Coalescing adopts the LATEST lease epoch — earlier queued ops
-        are covered by the newer admit decision — and keeps a sampled
-        trace context if any submit in the batch carried one."""
+        are covered by the newer admit decision — keeps a sampled trace
+        context if any submit in the batch carried one, and keeps the
+        most URGENT class seen (an interactive re-touch of a queued
+        bulk doc must not wait out the bulk deadline)."""
         where = self._where[shard]
+        cdepth = self._class_depth[shard]
         old_bucket = where.get(doc_id)
         if old_bucket is not None:
             item = self._q[shard][old_bucket].pop(doc_id)
@@ -108,18 +151,29 @@ class AdmissionQueue:
             item.epoch = epoch
             if trace is not None:
                 item.trace = trace
+            if QOS_PRIORITY.get(qos, 0) < QOS_PRIORITY.get(item.qos, 0):
+                cdepth[item.qos] = cdepth.get(item.qos, 1) - 1
+                cdepth[qos] = cdepth.get(qos, 0) + 1
+                item.qos = qos
             bucket = shape_bucket(item.n_ops)
             self._q[shard].setdefault(bucket, {})[doc_id] = item
             where[doc_id] = bucket
             return bucket
+        ctl = self.qos
         if len(where) >= self.max_pending:
             # the deadline trigger drains the oldest bucket within one
             # deadline window; that is the honest earliest retry time
-            raise Backpressure(shard, len(where), self.flush_deadline_s)
+            raise Backpressure(shard, len(where),
+                               self._deadline_for(shard, qos))
+        if ctl is not None and cdepth.get(qos, 0) \
+                >= ctl.depth_budget(qos, self.max_pending):
+            raise Backpressure(shard, cdepth.get(qos, 0),
+                               self._deadline_for(shard, qos))
         bucket = shape_bucket(n_ops)
         self._q[shard].setdefault(bucket, {})[doc_id] = PendingMerge(
-            doc_id, max(int(n_ops), 1), now, epoch, trace)
+            doc_id, max(int(n_ops), 1), now, epoch, trace, qos)
         where[doc_id] = bucket
+        cdepth[qos] = cdepth.get(qos, 0) + 1
         return bucket
 
     # ---- flush triggers --------------------------------------------------
@@ -138,8 +192,13 @@ class AdmissionQueue:
                 elif len(docs) >= self.flush_docs:
                     out.append((shard, bucket, "size"))
                 else:
+                    # deadline per the bucket's OLDEST entry's class: a
+                    # mixed bucket flushes on its most-waited item, so
+                    # a stretched bulk deadline can never starve an
+                    # interactive doc queued behind it
                     oldest = next(iter(docs.values()))
-                    if now - oldest.enqueued_at >= self.flush_deadline_s:
+                    if now - oldest.enqueued_at \
+                            >= self._deadline_for(shard, oldest.qos):
                         out.append((shard, bucket, "deadline"))
         return out
 
@@ -152,9 +211,16 @@ class AdmissionQueue:
             return []
         k = limit if limit is not None else self.flush_docs
         out = []
+        cdepth = self._class_depth[shard]
         for doc_id in list(docs)[:k]:
-            out.append(docs.pop(doc_id))
+            item = docs.pop(doc_id)
+            out.append(item)
             del self._where[shard][doc_id]
+            left = cdepth.get(item.qos, 1) - 1
+            if left > 0:
+                cdepth[item.qos] = left
+            else:
+                cdepth.pop(item.qos, None)
         if not docs:
             del self._q[shard][bucket]
         return out

@@ -167,6 +167,9 @@ class MergeScheduler:
         # serve.hydrate.Hydrator (attach_hydrator); None = the classic
         # everything-resident scheduler — no prefetch, no flush gate
         self.hydrator = None
+        # qos.QosController (attach_qos); None = the static size-or-
+        # deadline trigger, byte-identical to the pre-QoS scheduler
+        self.qos = None
         # read.attach_follower_reads wires this to ReadPath.on_flush:
         # a completed flush moved the doc's merged tip, so the
         # follower-read checkout cache drops the doc's entries. Called
@@ -200,6 +203,8 @@ class MergeScheduler:
         # windowed TimeSeries (rate()/quantile() "now" queries + SLO
         # burn rates); per-doc/agent usage feeds the top-K sketch
         self.metrics.ts = getattr(obs, "ts", None)
+        if self.qos is not None:
+            self.qos.attach_obs(obs)
         for bank in self.banks:
             bank.recorder = obs.recorder
             bank.journey = getattr(obs, "journey", None)
@@ -229,10 +234,26 @@ class MergeScheduler:
         for bank in self.banks:
             bank.snapshot_hook = hydrator.request_snapshot
 
+    def attach_qos(self, controller) -> None:
+        """Wire a qos.QosController into the admission path: the queue
+        consults its published per-(shard, class) effective deadlines
+        in place of the static trigger, submits bump its per-class
+        counters, and start_pump/stop_pump own its control-loop thread.
+        The controller takes its `qos` witness lock BEFORE this
+        scheduler's global lock (qos(8) -> global(10) in the canonical
+        order) when it reads queue fill each step."""
+        controller.bind(self.queue, queue_lock=self.lock,
+                        n_shards=self.queue.n_shards)
+        if self.obs is not None:
+            controller.attach_obs(self.obs)
+        self.qos = controller
+        self.queue.qos = controller
+
     # ---- intake ----------------------------------------------------------
 
     def submit(self, doc_id: str, n_ops: int = 1,
-               now: Optional[float] = None, trace=None) -> dict:
+               now: Optional[float] = None, trace=None,
+               qos: Optional[str] = None) -> dict:
         """Queue pending merge work. Returns {"accepted": True, "shard",
         "bucket"}, {"accepted": False, "retry_after"} on backpressure,
         or {"accepted": False, "reason": "not_owner"} when the
@@ -240,8 +261,12 @@ class MergeScheduler:
         normal operation under load / during handoff). `trace` is an
         optional obs SpanContext (the originating HTTP edit); when its
         trace is sampled the admit, the ownership gate, and later the
-        flush + device sync all join it."""
+        flush + device sync all join it. `qos` is the ingress-
+        classified class (qos/classes.py; default interactive) — the
+        shed gate itself runs at HTTP ingress, BEFORE the edit is
+        durable, not here."""
         now = time.monotonic() if now is None else now
+        qos_cls = qos or "interactive"
         obs = self.obs
         span = NOOP_SPAN
         if obs is not None:
@@ -287,15 +312,21 @@ class MergeScheduler:
             try:
                 bucket = self.queue.submit(shard, doc_id, n_ops, now,
                                            epoch=epoch,
-                                           trace=span.context())
+                                           trace=span.context(),
+                                           qos=qos_cls)
             except Backpressure as bp:
                 self.metrics.bump(shard, "rejects")
                 span.end(outcome="backpressure")
                 return {"accepted": False, "shard": shard,
-                        "retry_after": bp.retry_after}
+                        "retry_after": bp.retry_after,
+                        "qos": qos_cls}
             if already:
                 self.metrics.bump(shard, "coalesced")
             self.metrics.observe_queue(shard, self.queue.depth(shard))
+        if self.qos is not None:
+            # per-class admitted counter — also the controller's
+            # arrival-rate estimator input (qos.admitted.<cls> series)
+            self.qos.metrics.bump_class(qos_cls, "admitted")
         span.end(outcome="queued", shard=shard, bucket=bucket)
         if obs is not None and span.sampled:
             # journey: open at the scheduler when the HTTP handler did
@@ -868,8 +899,14 @@ class MergeScheduler:
 
         self._pump_thread = threading.Thread(target=loop, daemon=True)
         self._pump_thread.start()
+        if self.qos is not None:
+            # the controller's loop lives and dies with the pump: no
+            # pump, no flushes, nothing for the deadlines to steer
+            self.qos.start()
 
     def stop_pump(self, drain: bool = True) -> None:
+        if self.qos is not None:
+            self.qos.stop()
         self._pump_stop.set()
         if self._pump_thread is not None:
             self._pump_thread.join(timeout=2)

@@ -794,6 +794,7 @@ def cmd_obs_watch(args) -> int:
                               .get("journey"),
                               "devprof": (doc.get("obs") or {})
                               .get("devprof"),
+                              "qos": doc.get("qos"),
                               "scenario": (doc.get("obs") or {})
                               .get("scenario")}))
         else:
@@ -828,6 +829,30 @@ def cmd_obs_watch(args) -> int:
                       f"burn fast={fast.get('burn', 0):7.2f} "
                       f"slow={slow.get('burn', 0):7.2f} "
                       f"(bad {fast.get('bad', 0)}/{fast.get('total', 0)})")
+            qos = doc.get("qos")
+            if qos:
+                # adaptive-admission panel: per-class effective
+                # deadlines + admit/shed/defer counters and the mesh
+                # shed gate (the /debug/qos document, inlined here via
+                # the /metrics qos block)
+                shed = qos.get("shed") or {}
+                why = shed.get("mesh_why") or ""
+                print(f"== qos (mesh={shed.get('mesh_state', 'ok')}"
+                      + (f" {why}" if why else "")
+                      + (" hot=" + ",".join(shed.get("hot_tenants"))
+                         if shed.get("hot_tenants") else "") + ") ==")
+                for cls, row in sorted((qos.get("classes") or {})
+                                       .items()):
+                    dl_ms = row.get("deadline_s", 0) * 1e3
+                    print(f"  {cls:<14s} deadline={dl_ms:8.2f}ms "
+                          f"admitted={row.get('admitted', 0):<8d} "
+                          f"shed={row.get('shed', 0):<6d} "
+                          f"deferred={row.get('deferred', 0)}")
+                ctl = qos.get("controller") or {}
+                print("  ctl " + " ".join(
+                    f"{k}={ctl.get(k, 0)}"
+                    for k in ("steps", "stretched", "shrunk", "held",
+                              "floors", "ceilings")))
             print("== hot docs ==")
             for kind, block in sorted((hot.get("doc") or {}).items()):
                 tops = (block.get("top") or [])[:args.top]
@@ -993,7 +1018,7 @@ def cmd_scenario(args) -> int:
         import dataclasses
         sc = dataclasses.replace(sc, seed=args.seed)
     card = run_scenario(sc, data_dir=args.data_dir,
-                        progress=args.progress)
+                        progress=args.progress, qos=args.qos)
     print(json.dumps(card, indent=1 if args.json else None))
     if args.out:
         with open(args.out, "w") as f:
@@ -1410,6 +1435,13 @@ def main(argv=None) -> int:
                    help="bank-lane home directory (default: a fresh "
                    "temp dir, removed afterwards)")
     c.add_argument("--progress", action="store_true")
+    c.add_argument("--qos", dest="qos", action="store_true",
+                   default=True,
+                   help="attach the adaptive-admission QoS controller "
+                   "to every scenario server (default)")
+    c.add_argument("--no-qos", dest="qos", action="store_false",
+                   help="static admission — the A/B control arm for "
+                   "scorecard-diff against an adaptive run")
     c.add_argument("--json", action="store_true",
                    help="pretty-print the scorecard")
     c.set_defaults(fn=cmd_scenario)

@@ -216,7 +216,8 @@ class DocStore:
         sync_lock=self.lock (so bank syncs never race handler threads)."""
         self.scheduler = scheduler
 
-    def submit_merge(self, doc_id: str, n_ops: int = 1, trace=None):
+    def submit_merge(self, doc_id: str, n_ops: int = 1, trace=None,
+                     qos: Optional[str] = None):
         """Queue merge work for the doc's shard. No-op (returns None)
         when no scheduler is attached. Backpressure rejects are the
         scheduler's problem, not the edit's: the edit is already durably
@@ -226,11 +227,12 @@ class DocStore:
         scheduler.lock then self.lock; a caller holding self.lock here
         would invert that order and deadlock). `trace` is an optional
         obs SpanContext linking the queued work back to the HTTP
-        request that produced it."""
+        request that produced it; `qos` the ingress-classified QoS
+        class (qos/classes.py) deciding the work's flush deadline."""
         sched = self.scheduler
         if sched is None:
             return None
-        return sched.submit(doc_id, n_ops=n_ops, trace=trace)
+        return sched.submit(doc_id, n_ops=n_ops, trace=trace, qos=qos)
 
     def cond(self, doc_id: str) -> threading.Condition:
         with self.lock:
@@ -716,7 +718,10 @@ class SyncHandler(BaseHTTPRequestHandler):
             doc = {"serve": sched.metrics_json() if sched else None,
                    "replication": node.metrics_json() if node else None,
                    "read": self.store.reads.metrics.snapshot()
-                   if self.store.reads is not None else None}
+                   if self.store.reads is not None else None,
+                   "qos": sched.qos.export()
+                   if sched is not None and sched.qos is not None
+                   else None}
             if obs is not None:
                 doc["obs"] = obs.snapshot()
             qs = urllib.parse.parse_qs(
@@ -771,6 +776,15 @@ class SyncHandler(BaseHTTPRequestHandler):
                     200,
                     json.dumps(obs.attrib.snapshot()).encode("utf8"),
                     extra=no_store)
+            if len(parts) == 2 and parts[1] == "qos":
+                # adaptive-admission controller state: per-class
+                # effective deadlines + counters, shed gate, specs
+                sched = self.store.scheduler
+                qctl = sched.qos if sched is not None else None
+                out = qctl.export() if qctl is not None \
+                    else {"enabled": False}
+                return self._send(200, json.dumps(out).encode("utf8"),
+                                  extra=no_store)
             if obs is not None and parts[1:2] == ["trace"] \
                     and len(parts) == 3:
                 # local spans of one trace, plus this host's monotonic
@@ -1041,6 +1055,14 @@ class SyncHandler(BaseHTTPRequestHandler):
             # per-doc request-byte attribution (the agent dimension is
             # noted in the JSON handlers once the body names one)
             obs.attrib.note("bytes", doc=doc_id, n=float(n))
+        # QoS ingress classification: explicit X-DT-QoS header wins,
+        # anti-entropy pushes (X-DT-Replication) are catchup, everything
+        # else interactive. Classified BEFORE the ownership proxy so a
+        # forwarded mutation keeps its class at the owner.
+        qos_cls = None
+        if action in ("push", "edit", "ops", "changes"):
+            from ..qos.classes import classify_headers, tenant_of
+            qos_cls = classify_headers(self.headers)
         node = self.store.replica
         if node is not None and action in ("push", "edit", "ops"):
             # Fencing check first: a proxied mutation carries the lease
@@ -1080,10 +1102,33 @@ class SyncHandler(BaseHTTPRequestHandler):
                 else:
                     relay = node.proxy(target, self.path, body,
                                        doc_id=doc_id,
-                                       trace=self._trace_ctx())
+                                       trace=self._trace_ctx(),
+                                       qos=qos_cls)
                     if relay is not None:
                         status, resp = relay
                         return self._send(status, resp)
+        if qos_cls is not None:
+            # Shed gate — consulted BEFORE the mutation touches the
+            # oplog, so a shed is a real load shield (nothing becomes
+            # durable that a flush must later pay for). The controller
+            # 429s sheddable classes when the mesh burns and any class
+            # when its tenant's token bucket is dry; interactive under
+            # a healthy mesh always passes.
+            sched = self.store.scheduler
+            qctl = sched.qos if sched is not None else None
+            if qctl is not None:
+                admitted, retry_after, reason = qctl.admit(
+                    qos_cls, tenant=tenant_of(doc_id))
+                if not admitted:
+                    return self._send(
+                        429,
+                        json.dumps({"error": "shed", "qos": qos_cls,
+                                    "reason": reason,
+                                    "retry_after": round(retry_after, 3)}
+                                   ).encode("utf8"),
+                        extra={"Retry-After":
+                               f"{max(retry_after, 0.0):.3f}",
+                               "Cache-Control": "no-store"})
         ol = self.store.get(doc_id)
         if action == "pull":
             if is_frame(body):
@@ -1173,7 +1218,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                     obs.journey.begin(agents[0] if agents else None,
                                       None, doc=doc_id,
                                       trace=tctx.trace_id)
-                self.store.submit_merge(doc_id, n_new, trace=tctx)
+                self.store.submit_merge(doc_id, n_new, trace=tctx,
+                                        qos=qos_cls)
             return self._send(200, json.dumps(
                 {"ok": True, "collisions": collisions}).encode("utf8"))
         if action == "edit":
@@ -1244,7 +1290,8 @@ class SyncHandler(BaseHTTPRequestHandler):
                            None)
                 obs.journey.begin(req["agent"], seq, doc=doc_id,
                                   trace=tctx.trace_id)
-            self.store.submit_merge(doc_id, len(ops), trace=tctx)
+            self.store.submit_merge(doc_id, len(ops), trace=tctx,
+                                    qos=qos_cls)
             return self._send(200, json.dumps({"version": out})
                               .encode("utf8"))
         if action == "changes":
@@ -1384,7 +1431,9 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
           replicate_opts: Optional[dict] = None,
           obs_opts: Optional[dict] = None,
           follower_reads: bool = False,
-          read_opts: Optional[dict] = None) -> ThreadingHTTPServer:
+          read_opts: Optional[dict] = None,
+          qos: bool = False,
+          qos_opts: Optional[dict] = None) -> ThreadingHTTPServer:
     """`peers` is the static mesh (["host:port", ...], may include
     this server's own address — it is dropped from the table). With
     peers set, a replicate.ReplicaNode is attached and started: health
@@ -1410,6 +1459,11 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
                                engine="host", sync_lock=store.lock)
         store.attach_scheduler(sched)
         sched.attach_obs(store.obs)
+        if qos:
+            # attach BEFORE start_pump so the controller thread starts
+            # (and stops) with the scheduler's own lifecycle
+            from ..qos import QosController
+            sched.attach_qos(QosController(**(qos_opts or {})))
         sched.start_pump()
     if follower_reads:
         # staleness-bounded local GETs on non-owner replicas + the
@@ -1527,6 +1581,11 @@ def main() -> None:
                    "under the staleness contract (?max_staleness= + "
                    "X-DT-Min-Version) instead of always locally; "
                    "contract misses proxy to the doc's owner")
+    p.add_argument("--qos", action="store_true",
+                   help="attach the adaptive-admission QoS controller "
+                   "(qos/): per-class effective flush deadlines, depth "
+                   "budgets and mesh-aware 429 load shedding; state at "
+                   "/debug/qos (requires --serve-shards)")
     args = p.parse_args()
     peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
         if args.peers else ([] if args.join else None)
@@ -1535,7 +1594,8 @@ def main() -> None:
                   replicate_opts={"lease_ttl_s": args.lease_ttl,
                                   "join": args.join},
                   obs_opts={"sample_rate": args.obs_sample_rate},
-                  follower_reads=args.follower_reads)
+                  follower_reads=args.follower_reads,
+                  qos=args.qos)
     print(f"serving on http://127.0.0.1:{args.port}"
           + (f" (mesh: {','.join(peers)})" if peers else ""))
     httpd.serve_forever()

@@ -65,6 +65,7 @@ class _Counts:
         self.read_refusals = 0   # follower 503s (staleness contract)
         self.bulk_ops = 0
         self.bank_edits = 0
+        self.sheds = 0           # QoS 429s (deliberate, not errors)
         self.errors = 0
         self.bytes_sent = 0
         self.bytes_received = 0
@@ -78,7 +79,8 @@ class _Counts:
                 "write_ops": self.write_ops, "reads": self.reads,
                 "read_refusals": self.read_refusals,
                 "bulk_ops": self.bulk_ops,
-                "bank_edits": self.bank_edits, "errors": self.errors,
+                "bank_edits": self.bank_edits, "sheds": self.sheds,
+                "errors": self.errors,
                 "bytes_sent": self.bytes_sent,
                 "bytes_received": self.bytes_received}
 
@@ -113,7 +115,14 @@ def _build_events(sc: Scenario) -> List[tuple]:
 
 
 def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
-                 progress: bool = False) -> dict:
+                 progress: bool = False, qos: bool = False) -> dict:
+    """`qos=True` attaches the adaptive-admission controller to every
+    server and tags lanes with their class (interactive edits vs bulk
+    imports); the scorecard then carries a `qos` block merged across
+    the mesh. Default False keeps the static admission path byte-
+    identical — the A/B control arm for `scorecard-diff`."""
+    from ..qos.classes import QOS_HEADER
+    from ..qos.metrics import merge_snapshots
     from ..replicate.node import attach_replication
     from ..tools.server import serve
 
@@ -129,7 +138,7 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
     for i in range(sc.servers):
         httpd = serve(port=0, serve_shards=sc.serve_shards,
                       data_dir=None, follower_reads=True,
-                      obs_opts=dict(sample_rate=1.0))
+                      obs_opts=dict(sample_rate=1.0), qos=qos)
         httpds.append(httpd)
         addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
     for i, httpd in enumerate(httpds):
@@ -151,16 +160,25 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
 
     # ---- HTTP primitives -------------------------------------------------
     def post_edit(si: int, doc: str, session: _Session,
-                  ops: List[dict]) -> bool:
+                  ops: List[dict], qos_cls: Optional[str] = None) -> bool:
         body = json.dumps({"agent": session.agent,
                            "version": session.versions.get(doc, []),
                            "ops": ops}).encode("utf8")
         req = urllib.request.Request(
             f"http://{addrs[si]}/doc/{doc}/edit", data=body)
+        if qos_cls is not None:
+            req.add_header(QOS_HEADER, qos_cls)
         counts.bytes_sent += len(body)
         try:
             with urllib.request.urlopen(req, timeout=5) as r:
                 resp = r.read()
+        except urllib.error.HTTPError as e:
+            e.close()
+            if e.code == 429:    # deliberate QoS shed, not a failure
+                counts.sheds += 1
+            else:
+                counts.errors += 1
+            return False
         except OSError:
             counts.errors += 1
             return False
@@ -248,7 +266,8 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                 payload = "x" * int(sc.bulk.get("bytes_per_op", 1024))
                 if post_edit(rng.randrange(sc.servers), doc, ses,
                              [{"kind": "ins", "pos": 0,
-                               "text": payload}]):
+                               "text": payload}],
+                             qos_cls="bulk" if qos else None):
                     counts.bulk_ops += 1
             elif kind == "churn":
                 gen += 1
@@ -333,6 +352,16 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                         if serve_snaps[i] else 0),
         "visibility_p99_s": round(vis_p99s[i], 6),
     } for i in range(sc.servers)]
+    # QoS: merge every server's QosMetrics snapshot into one mesh-wide
+    # block (None when the controller was off, so A/B control cards
+    # diff clean against pre-QoS baselines)
+    qos_block = merge_snapshots([
+        h.store.scheduler.qos.metrics.snapshot()
+        if h.store.scheduler is not None
+        and h.store.scheduler.qos is not None else None
+        for h in httpds])
+    if qos_block is not None:
+        qos_block["sheds_observed"] = counts.sheds
     wall_s = time.monotonic() - t_start
     ok = bool(converged and slo_ok and counts.errors == 0)
 
@@ -357,6 +386,7 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         wire=wire,
         per_server=per_server,
         ok=ok,
+        qos=qos_block,
         extra={"session_churns": session_churns,
                **({"bank": bank_report} if bank_report else {})},
     )

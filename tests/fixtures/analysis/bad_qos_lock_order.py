@@ -1,0 +1,18 @@
+"""Seeded dt-lint fixture: qos controller lock-order violation.
+
+Acquires the adaptive-admission controller's `_qos_lock` (qos, 8)
+while already holding the scheduler's global lock (10) — backwards
+against the canonical order: the control loop takes qos THEN global
+to read queue fills, and code on the hot admission path under the
+global lock must read the published deadline table lock-free, never
+the controller's own lock (that inversion is exactly the deadlock the
+rung exists to forbid).
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureScheduler:
+    def backwards(self, shard):
+        with self.lock:
+            with self._qos_lock:
+                return self.queue.bucket_fill(shard)

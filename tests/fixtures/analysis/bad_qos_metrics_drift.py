@@ -1,0 +1,12 @@
+"""Seeded dt-lint fixture: QoS metrics-schema drift.
+
+Bumps a per-class admission counter key that qos.metrics.
+QOS_CLASS_KEYS does not declare — the dt_qos_*{class} prom families
+zero-fill only the declared tuple, so the counter would never export.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureGate:
+    def note_shed(self, cls):
+        self.metrics.bump_class(cls, "shedded")

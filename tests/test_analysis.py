@@ -55,6 +55,7 @@ def _witness_clean():
     ("bad_hydration_lock_order.py", "lock-order", 14, "error"),
     ("bad_read_lock_order.py", "lock-order", 15, "error"),
     ("bad_rebalance_lock_order.py", "lock-order", 14, "error"),
+    ("bad_qos_lock_order.py", "lock-order", 17, "error"),
     ("bad_ts_lock_order.py", "lock-order", 15, "error"),
     ("bad_wire_lock_order.py", "lock-order", 14, "error"),
     ("bad_xform_lock_order.py", "lock-order", 15, "error"),
@@ -66,6 +67,7 @@ def _witness_clean():
     ("bad_blocking_call.py", "blocking-call-under-lock", 14, "warn"),
     ("bad_unguarded_acquire.py", "unguarded-acquire", 12, "error"),
     ("bad_metrics_drift.py", "metrics-schema-drift", 11, "error"),
+    ("bad_qos_metrics_drift.py", "metrics-schema-drift", 12, "error"),
     ("bad_exemplar_drift.py", "metrics-schema-drift", 9, "error"),
     ("bad_stale_suppression.py", "stale-suppression", 11, "warn"),
     # the two historical bugs PR 7's tree repairs fixed, re-expressed
