@@ -25,7 +25,10 @@ QoS (qos/): every item carries a class (interactive/bulk/catchup).
 With a controller attached (`self.qos`, set by MergeScheduler.
 attach_qos) the deadline trigger consults the controller's published
 per-(shard, class) effective deadline instead of the static
-`flush_deadline_s`, and each class is additionally bounded to its own
+`flush_deadline_s` — each class's OWN oldest entry is checked, so a
+mixed bucket flushes when the earliest per-class deadline passes (a
+stretched bulk deadline never delays an interactive doc queued behind
+it) — and each class is additionally bounded to its own
 depth budget (a fraction of `max_pending`). With no controller the
 static trigger runs byte-identically to before — the qos field rides
 along inert.
@@ -184,6 +187,8 @@ class AdmissionQueue:
         deadline trigger fired (every non-empty bucket when `force`)."""
         out: List[Tuple[int, int, str]] = []
         for shard in range(self.n_shards):
+            # class -> effective deadline, memoized per shard pass
+            deadlines: Dict[str, float] = {}
             for bucket, docs in self._q[shard].items():
                 if not docs:
                     continue
@@ -192,14 +197,25 @@ class AdmissionQueue:
                 elif len(docs) >= self.flush_docs:
                     out.append((shard, bucket, "size"))
                 else:
-                    # deadline per the bucket's OLDEST entry's class: a
-                    # mixed bucket flushes on its most-waited item, so
-                    # a stretched bulk deadline can never starve an
-                    # interactive doc queued behind it
-                    oldest = next(iter(docs.values()))
-                    if now - oldest.enqueued_at \
-                            >= self._deadline_for(shard, oldest.qos):
-                        out.append((shard, bucket, "deadline"))
+                    # deadline: fire when ANY entry has outlived its
+                    # OWN class's effective deadline — equivalently,
+                    # min over items of (enqueued_at + deadline(qos))
+                    # has passed. A mixed bucket flushes on whichever
+                    # class's oldest entry is due first, so a
+                    # stretched bulk deadline can never starve an
+                    # interactive doc queued behind it in the same
+                    # shape bucket. (Checking every item, not just the
+                    # first in dict order, also covers coalesced
+                    # entries: coalescing re-inserts at the dict tail
+                    # while keeping the original enqueue time.)
+                    for item in docs.values():
+                        d = deadlines.get(item.qos)
+                        if d is None:
+                            d = deadlines[item.qos] = \
+                                self._deadline_for(shard, item.qos)
+                        if now - item.enqueued_at >= d:
+                            out.append((shard, bucket, "deadline"))
+                            break
         return out
 
     def take(self, shard: int, bucket: int,

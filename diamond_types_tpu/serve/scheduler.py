@@ -57,6 +57,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..analysis.witness import make_lock
 from ..obs.trace import NOOP_SPAN
+from ..qos.classes import QOS_PRIORITY
 from .admission import AdmissionQueue, Backpressure
 from .bank import SessionBank
 from .metrics import ServeMetrics
@@ -264,9 +265,12 @@ class MergeScheduler:
         flush + device sync all join it. `qos` is the ingress-
         classified class (qos/classes.py; default interactive) — the
         shed gate itself runs at HTTP ingress, BEFORE the edit is
-        durable, not here."""
+        durable, not here. Unknown classes normalize to interactive
+        (mirroring classify_headers' typo-safe fallback) so a direct
+        library caller can't poison per-class depth accounting or trip
+        QosMetrics on an undeclared class."""
         now = time.monotonic() if now is None else now
-        qos_cls = qos or "interactive"
+        qos_cls = qos if qos in QOS_PRIORITY else "interactive"
         obs = self.obs
         span = NOOP_SPAN
         if obs is not None:

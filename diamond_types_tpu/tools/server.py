@@ -1058,9 +1058,12 @@ class SyncHandler(BaseHTTPRequestHandler):
         # QoS ingress classification: explicit X-DT-QoS header wins,
         # anti-entropy pushes (X-DT-Replication) are catchup, everything
         # else interactive. Classified BEFORE the ownership proxy so a
-        # forwarded mutation keeps its class at the owner.
+        # forwarded mutation keeps its class at the owner. Mutations
+        # ONLY: reads (e.g. the `changes` long-poll) never hit the shed
+        # gate, so a hot tenant's polling can't drain — or be throttled
+        # by — its own write token bucket.
         qos_cls = None
-        if action in ("push", "edit", "ops", "changes"):
+        if action in ("push", "edit", "ops"):
             from ..qos.classes import classify_headers, tenant_of
             qos_cls = classify_headers(self.headers)
         node = self.store.replica

@@ -328,6 +328,34 @@ def test_queue_deadline_trigger_consults_controller_per_class():
     assert (0, 4, "deadline") in q.due(0.6)
 
 
+def test_queue_mixed_bucket_interactive_not_starved_by_bulk():
+    # regression: an interactive doc enqueued BEHIND a bulk doc in the
+    # SAME shape bucket must flush on the interactive deadline, not
+    # wait out the bulk item's stretched one — due() consults every
+    # class's oldest entry, not just the first-inserted item's class
+    q = AdmissionQueue(1, max_pending=8, flush_docs=4,
+                       flush_deadline_s=0.05)
+    q.qos = StubCtl({"interactive": 0.05, "bulk": 2.0})
+    q.submit(0, "bulky", 1, now=0.0, qos="bulk")
+    q.submit(0, "quick", 1, now=0.1, qos="interactive")
+    assert q.due(0.1) == []
+    # fires at the interactive item's own deadline (0.1 + 0.05), far
+    # before bulk's stretched 2.0s window elapses
+    assert q.due(0.16) == [(0, 1, "deadline")]
+
+
+def test_queue_coalesced_entry_keeps_deadline_seniority():
+    # a coalescing re-submit re-inserts at the dict tail but keeps the
+    # ORIGINAL enqueue time; the deadline trigger must still see it as
+    # the bucket's most-waited entry
+    q = AdmissionQueue(1, max_pending=8, flush_docs=8,
+                       flush_deadline_s=0.05)
+    q.submit(0, "a", 3, now=0.0)            # bucket 4
+    q.submit(0, "b", 3, now=0.04)           # bucket 4, younger
+    q.submit(0, "a", 1, now=0.045)          # coalesce: a -> dict tail
+    assert q.due(0.051) == [(0, 4, "deadline")]
+
+
 def test_queue_coalesce_upgrades_to_urgent_class():
     q = AdmissionQueue(1, max_pending=8, flush_docs=4,
                        flush_deadline_s=0.05)
