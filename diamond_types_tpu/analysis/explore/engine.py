@@ -80,6 +80,7 @@ def _fingerprint(world: SimWorld, counts: Dict[str, int]) -> str:
             "journal": journal,
             "pending": pending,
             "overrides": node.overrides.as_json(),
+            "groups": node.writergroups.fingerprint(),
         }
     blob = json.dumps(doc, sort_keys=True, default=str)
     return hashlib.sha1(blob.encode("utf8")).hexdigest()

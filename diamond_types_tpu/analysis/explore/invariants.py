@@ -38,6 +38,8 @@ ALL_INVARIANTS = (
     "tie-break-direction",  # equal-epoch arbitration keeps the smaller id
     "convergence",          # byte-identical state after quiesce (leaves)
     "no-acked-loss",        # every acked (queued) op survives to quiesce
+    "group-epoch-exclusivity",  # no writer-group registration below
+                                # its own host's fencing floor
 )
 
 
@@ -131,6 +133,20 @@ class InvariantChecker:
                             "floor-coverage",
                             f"node {n} doc {d} floor {f} below held "
                             f"lease epoch {ld[1]}"))
+            if "group-epoch-exclusivity" in self.names:
+                groups = getattr(w.nodes[n], "writergroups", None)
+                if groups is not None:
+                    for d, g in groups.entries():
+                        f = floors.get(d, 0)
+                        if g.epoch < f:
+                            failures.append(Violation(
+                                "group-epoch-exclusivity",
+                                f"node {n} doc {d} holds a writer-"
+                                f"group registration at epoch "
+                                f"{g.epoch} below its own fencing "
+                                f"floor {f} — a member of the "
+                                f"superseded group could still admit "
+                                f"writes"))
         if "single-active" in self.names:
             for (d, ep), holders in self.active_holders.items():
                 if len(holders) > 1:

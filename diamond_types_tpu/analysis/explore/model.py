@@ -48,10 +48,10 @@ class Action:
             return "tick"
         if self.op in ("cut", "heal"):
             return f"{self.op}({self.node},{self.peer})"
-        if self.op in ("edit", "qedit", "acquire"):
+        if self.op in ("edit", "qedit", "gedit", "acquire", "demote"):
             return f"{self.op}({self.node},{self.doc})"
-        if self.op == "migrate":
-            return f"migrate({self.node},{self.peer},{self.doc})"
+        if self.op in ("migrate", "promote"):
+            return f"{self.op}({self.node},{self.peer},{self.doc})"
         return f"{self.op}({self.node})"
 
     def __repr__(self) -> str:
@@ -94,6 +94,20 @@ class Action:
             l = world.nodes[self.node].leases.get(self.doc)
             return l is not None and l.holder == self.node \
                 and l.state == ACTIVE
+        if op == "promote":
+            # the ACTIVE holder splits its own doc — once per trace is
+            # enough; re-promotion of a live group is refused anyway
+            node = world.nodes[self.node]
+            l = node.leases.get(self.doc)
+            return l is not None and l.holder == self.node \
+                and l.state == ACTIVE \
+                and node.writergroups.get(self.doc) is None
+        if op == "demote":
+            return world.nodes[self.node].can_demote(self.doc)
+        if op == "gedit":
+            # a member write is only offered where the member-side
+            # admission gate (incl. the self-fence) would admit it
+            return world.nodes[self.node].group_accepts(self.doc)
         if op == "flush":
             return bool(world.stores[self.node].pending)
         return True
@@ -106,8 +120,15 @@ class Action:
             world.qedit(self.node, self.doc)
         elif op == "flush":
             world.stores[self.node].scheduler.drain()
+        elif op == "gedit":
+            world.qedit(self.node, self.doc)
         elif op == "migrate":
             world.migrate(self.node, self.peer, self.doc)
+        elif op == "promote":
+            world.nodes[self.node].promote_writer_group(
+                self.doc, [self.peer])
+        elif op == "demote":
+            world.nodes[self.node].demote_writer_group(self.doc)
         elif op == "acquire":
             world.nodes[self.node].leases.ensure_local(self.doc, True)
         elif op == "step":
@@ -136,7 +157,7 @@ class Action:
         relation (disjoint footprints commute). Environment actions and
         anything that can touch every node are ALL — conservative is
         sound; it only costs reduction."""
-        if self.op in ("edit", "qedit", "flush"):
+        if self.op in ("edit", "qedit", "gedit", "flush"):
             return frozenset({f"{self.node}:oplog"})
         return frozenset({ALL})
 
@@ -303,3 +324,42 @@ _register(Scenario(
                 "partition and duplicate delivery: no interleaving "
                 "may lose an acknowledged op or activate two owners; "
                 "aborts must leave the doc owned at the source."))
+
+_register(Scenario(
+    "writer-group", ("n1", "n2", "n3"), ("d0",), quorum=True,
+    # tick_s > ttl_s: one tick expires leases, two expire the group
+    # registration TTL (2 * ttl_s) — so every TTL-gated path (member
+    # self-fence on expiry, demotion past a silent member) is
+    # reachable within the tick bound
+    ttl_s=2.0, tick_s=2.2,
+    # pre-state: n1 owns d0 with one acked-but-queued write — the op
+    # neither promotion nor demotion may lose
+    setup=_acts(("acquire", "n1", None, "d0"),
+                ("qedit", "n1", None, "d0")),
+    actions=_acts(
+        ("promote", "n1", "n2", "d0"),
+        ("demote", "n1", None, "d0"),
+        ("qedit", "n1", None, "d0"),
+        ("gedit", "n2", None, "d0"),
+        ("flush", "n2"),
+        ("step", "n1"), ("step", "n2"),
+        ("ae", "n1"), ("ae", "n2"),
+        ("tick",),
+        ("cut", "n1", "n2"), ("heal", "n1", "n2"),
+        ("crash", "n2"), ("restart", "n2"),
+        ("dup", "n2"),
+    ),
+    bounds={"promote": 1, "demote": 2, "qedit": 1, "gedit": 2,
+            "flush": 1, "step": 1, "ae": 1, "tick": 2, "cut": 1,
+            "heal": 1, "crash": 1, "restart": 1, "dup": 1},
+    invariants=("single-active", "promise-exclusivity",
+                "floor-monotonic", "floor-coverage",
+                "group-epoch-exclusivity", "no-acked-loss",
+                "convergence"),
+    description="hot-doc write splitting: promote n1's lease to a "
+                "{n1,n2} writer group, member writes on n2, fenced "
+                "demotion back to one writer — under member crash, "
+                "partition, duplicate grant/demote delivery and TTL "
+                "expiry. No interleaving may admit a write under a "
+                "superseded group epoch or lose an acked member "
+                "write across the demotion drain."))

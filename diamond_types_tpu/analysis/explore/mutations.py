@@ -92,6 +92,33 @@ def _mut_tiebreak_invert(node) -> None:
         node.leases, own_guard=True, smaller_wins=False)
 
 
+def _mut_group_drain_skip(node) -> None:
+    # the member-side demotion fence evicts the admission queue
+    # WITHOUT the drain barrier: acked member writes die at demote
+    node._group_demote_drains = False
+
+
+def _mut_promote_unratified(node) -> None:
+    # promotion commits without a majority round and without raising
+    # the leader's own fencing floor — one coherent bug ("the group
+    # grant is self-issued"): the leader's re-keyed lease epoch is not
+    # covered by its floor, so nothing fences the superseded epoch
+    real = node.promote_writer_group
+
+    def promote(doc_id, members):
+        rq = node._run_quorum
+        note = node.leases._note_epoch_locked
+        node._run_quorum = lambda d, e, t: True
+        node.leases._note_epoch_locked = lambda d, e: None
+        try:
+            return real(doc_id, members)
+        finally:
+            node._run_quorum = rq
+            node.leases._note_epoch_locked = note
+
+    node.promote_writer_group = promote
+
+
 def _mut_drain_skip(world) -> None:
     # the handoff's drain barrier no-ops: the final transfer patch is
     # cut while acked writes still sit in the admission queue, and the
@@ -142,4 +169,22 @@ MUTATIONS: Dict[str, Mutation] = {m.name: m for m in (
                     "loses them — an acknowledged op vanishes from "
                     "the converged state",
         apply_world=_mut_drain_skip, depth=2),
+    Mutation(
+        "demote-without-drain", scenario="writer-group",
+        expect=("no-acked-loss",),
+        description="the member-side demotion fence skips its drain "
+                    "barrier: a fenced member evicts its admission "
+                    "queue with acked group writes still in it — an "
+                    "acknowledged member write vanishes from the "
+                    "converged state",
+        apply_node=_mut_group_drain_skip, depth=3),
+    Mutation(
+        "promote-floor-drop", scenario="writer-group",
+        expect=("floor-coverage", "single-active"),
+        description="promotion commits without quorum ratification or "
+                    "the leader's floor raise: the re-keyed lease "
+                    "epoch is uncovered by the fencing floor, so the "
+                    "superseded single-writer epoch is never fenced "
+                    "off",
+        apply_node=_mut_promote_unratified, depth=2),
 )}

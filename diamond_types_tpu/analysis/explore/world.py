@@ -45,6 +45,7 @@ class MemJournal:
         self.promises: Dict[str, dict] = {}
         self.leases: Dict[str, dict] = {}
         self.overrides: Dict[str, dict] = {}
+        self.groups: Dict[str, dict] = {}
         self._dirty = False
 
     # -- writes (mirror ReplicaJournal's semantics) --
@@ -71,6 +72,17 @@ class MemJournal:
 
     def drop_lease(self, doc_id: str) -> None:
         self.leases.pop(doc_id, None)
+        self._dirty = True
+
+    def note_group(self, doc_id: str, epoch: int, members,
+                   leader: str) -> None:
+        self.groups[doc_id] = {"epoch": int(epoch),
+                               "members": [str(m) for m in members],
+                               "leader": str(leader)}
+        self._dirty = True
+
+    def drop_group(self, doc_id: str) -> None:
+        self.groups.pop(doc_id, None)
         self._dirty = True
 
     def note_override(self, doc_id: str, target, ver: int) -> None:
@@ -102,6 +114,9 @@ class MemJournal:
     def restored_overrides(self) -> Dict[str, dict]:
         return {d: dict(o) for d, o in self.overrides.items()}
 
+    def restored_groups(self) -> Dict[str, dict]:
+        return {d: dict(g) for d, g in self.groups.items()}
+
     def has_prior_state(self) -> bool:
         return self._dirty
 
@@ -111,7 +126,7 @@ class MemJournal:
     def fingerprint(self) -> dict:
         return {"inc": self.incarnation, "floors": self.max_epochs,
                 "promises": self.promises, "leases": self.leases,
-                "overrides": self.overrides}
+                "overrides": self.overrides, "groups": self.groups}
 
 
 class _SimScheduler:

@@ -9,8 +9,8 @@ Canonical order (must only ever grow rightward while locks are held):
 
   repl.maintain(0) -> repl.rebalance(1) -> repl.leases(2) ->
   repl.membership(3) -> repl.peers(4) -> repl.quorum(5) ->
-  qos(8) -> global(10) -> shard(20) -> io(25) -> oplog(30) ->
-  device(40) -> leaf(50)
+  repl.writergroup(6) -> qos(8) -> global(10) -> shard(20) ->
+  io(25) -> oplog(30) -> device(40) -> leaf(50)
 
 (`qos` is the adaptive-admission controller's rung, deliberately
 OUTER to the scheduler's global lock: the control loop takes qos then
@@ -21,6 +21,12 @@ never take the qos lock.)
 (`repl.rebalance` is the elastic-mesh planning rung: the rebalancer
 plans migrations under it and may then take lease state, but lease
 code must never call back into the planner — outer to repl.leases.)
+
+(`repl.writergroup` is the hot-doc write-splitting table's rung,
+deliberately INNER to the lease lock: the lease table's floor-raise
+hook fences group registrations while the lease lock is held, and the
+group table never calls back into lease state while its own lock is
+held — taking them the other way around deadlocks against the hook.)
 
 (`io` is the DocStore flush-pass serializer: it is deliberately OUTER
 to the oplog guard — encode runs under the store lock inside an
@@ -51,6 +57,7 @@ ORDER_LEVELS = {
     "repl.membership": 3,
     "repl.peers": 4,
     "repl.quorum": 5,
+    "repl.writergroup": 6,
     "qos": 8,
     "global": 10,
     "shard": 20,
@@ -108,6 +115,10 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
         return "repl.rebalance"
     if src.endswith("leases.lock"):
         return "repl.leases"
+    # hot-doc write splitting: the group table's lock is INNER to the
+    # lease lock (the floor-raise hook fences registrations under it)
+    if src.endswith("writergroups.lock"):
+        return "repl.writergroup"
     if "io_lock" in src:
         return "io"
     # residency tier: the hydrator's warm-map guard, the tier's table
@@ -157,6 +168,8 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
             return "repl.peers"
         if "Quorum" in class_name:
             return "repl.quorum"
+        if "WriterGroup" in class_name:
+            return "repl.writergroup"
         if "Membership" in class_name:
             return "repl.membership"
         return None

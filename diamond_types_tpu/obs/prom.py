@@ -13,6 +13,9 @@ Naming scheme:
   dt_repl_<group>_<key>_total         replication counters
   dt_rebalance_<counter>_total /      elastic-mesh migrations (zero-
   dt_rebalance_override_table_size    filled) + override-table gauge
+  dt_writergroup_<counter>_total /    hot-doc write splitting (zero-
+  dt_writergroup_{active_groups,      filled counters + point-in-time
+                  member_entries}    table gauges)
   dt_wire_<key>_total{channel}        wire-tier transport accounting
                                       (bytes_sent, bytes_saved, frames,
                                       snapshot_ships per channel)
@@ -311,6 +314,18 @@ def _render_replication(b: _Builder, repl: dict) -> None:
                 b.add("dt_rebalance_override_table_size", "gauge", v)
             else:
                 b.add(f"dt_rebalance_{k}_total", "counter", v)
+    # hot-doc write splitting: dedicated dt_writergroup_* families,
+    # zero-filled like rebalance; the two table sizes are point-in-time
+    # gauges, the rest are counters.
+    wg = repl.get("writergroup")
+    if isinstance(wg, dict):
+        for k, v in sorted(wg.items()):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if k in ("active_groups", "member_entries"):
+                b.add(f"dt_writergroup_{k}", "gauge", v)
+            else:
+                b.add(f"dt_writergroup_{k}_total", "counter", v)
     # wire tier: per-channel transport accounting as dedicated labeled
     # dt_wire_* families — the flat `{channel}_{key}` snapshot keys
     # split back into a channel label so dashboards can sum/stack the
@@ -330,10 +345,10 @@ def _render_replication(b: _Builder, repl: dict) -> None:
                 not isinstance(vals, dict):
             continue
         if group in ("per_peer", "membership_view", "quorum_view",
-                     "faults", "rebalance", "wire"):
-            # rebalance / wire rendered above under their own
-            # dt_rebalance_* / dt_wire_* prefixes, not the generic
-            # dt_repl_* one
+                     "faults", "rebalance", "wire", "writergroup"):
+            # rebalance / wire / writergroup rendered above under their
+            # own dt_rebalance_* / dt_wire_* / dt_writergroup_*
+            # prefixes, not the generic dt_repl_* one
             continue
         for k, v in sorted(vals.items()):
             if isinstance(v, bool) or not isinstance(v, (int, float)):

@@ -74,6 +74,13 @@ class AntiEntropy:
         peers = [peer_id] if peer_id is not None \
             else [p for p in node.table.peer_ids()
                   if node.table.is_healthy(p)]
+        # writer-group co-members reconcile FIRST: a split hot doc's
+        # in-group visibility lag is the one convergence path user
+        # writes now depend on, so it gets the front of every round
+        groups = getattr(node, "writergroups", None)
+        co = groups.peer_set() if groups is not None else frozenset()
+        if co:
+            peers.sort(key=lambda p: (p not in co, p))
         report = {"peers": {}, "pulled": 0, "pushed": 0, "errors": 0}
         for p in peers:
             rep = self._round_with(p)

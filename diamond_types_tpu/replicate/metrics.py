@@ -6,6 +6,13 @@ snapshot carries a `version` field so soak/bench scrapers can detect
 counter-set changes across PRs.
 
 Changelog:
+  v8  writer groups: new `writergroup` group — hot-doc write splitting
+      (`promotions`, `demotions`, `demote_aborts`, `member_grants`,
+      `member_admits`, `renewals`, `renewal_denials`, `self_fenced`,
+      `stale_installs_rejected`, plus `active_groups` /
+      `member_entries` injected by the node at snapshot time).
+      Exported as `dt_repl_writergroup_*` prom families like every
+      other group.
   v7  wire tier: new `wire` group — per-channel transport accounting
       (`{channel}_{bytes_sent,bytes_saved,frames,snapshot_ships}` for
       the antientropy / proxy / hydrate / gossip channels, exported as
@@ -112,12 +119,16 @@ _GROUPS = {
     "membership": ("joins", "leaves", "suspicions", "refutations",
                    "deaths"),
     "wire": tuple(f"{c}_{k}" for c in WIRE_CHANNELS for k in WIRE_KEYS),
+    "writergroup": ("promotions", "demotions", "demote_aborts",
+                    "member_grants", "member_admits", "renewals",
+                    "renewal_denials", "self_fenced",
+                    "stale_installs_rejected"),
 }
 
 
 class ReplicationMetrics:
-    # v6 -> v7: per-channel wire transport group (see changelog)
-    SCHEMA_VERSION = 7
+    # v7 -> v8: writer-group hot-doc split counters (see changelog)
+    SCHEMA_VERSION = 8
 
     def __init__(self, self_id: str = "") -> None:
         self.self_id = self_id
@@ -164,7 +175,8 @@ class ReplicationMetrics:
     def snapshot(self, leases_held: int = 0, per_peer: dict = None,
                  faults: dict = None, membership_view: dict = None,
                  quorum_view: dict = None,
-                 override_table_size: int = 0) -> dict:
+                 override_table_size: int = 0,
+                 writergroup_sizes: dict = None) -> dict:
         # histograms carry their own locks; snapshot before taking ours
         latencies = {n: h.snapshot() for n, h in
                      sorted(self.hist.items())}
@@ -180,6 +192,9 @@ class ReplicationMetrics:
             handoffs["latency_s_max"] = handoff["max"]
             rebalance = dict(self._c["rebalance"])
             rebalance["override_table_size"] = int(override_table_size)
+            writergroup = dict(self._c["writergroup"])
+            for k, v in (writergroup_sizes or {}).items():
+                writergroup[k] = int(v)
             return {
                 "version": self.SCHEMA_VERSION,
                 "self": self.self_id,
@@ -194,6 +209,7 @@ class ReplicationMetrics:
                 "fencing": dict(self._c["fencing"]),
                 "membership": dict(self._c["membership"]),
                 "wire": dict(self._c["wire"]),
+                "writergroup": writergroup,
                 "latencies": latencies,
                 "per_peer": per_peer or {},
                 "membership_view": membership_view,

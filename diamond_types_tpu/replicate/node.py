@@ -65,10 +65,11 @@ from .antientropy import AntiEntropy
 from .faults import FaultInjector
 from .membership import ALIVE, LEFT, MembershipView
 from .metrics import ReplicationMetrics
-from .ownership import DRAINING, TRANSFER, LeaseManager, owner_of
+from .ownership import ACTIVE, DRAINING, TRANSFER, LeaseManager, owner_of
 from .peers import PeerTable
 from .quorum import QuorumCoordinator, ReplicaJournal
 from .rebalance import PlacementOverrides
+from .writergroup import WriterGroupTable
 
 MUTATION_ACTIONS = ("push", "edit", "ops")
 
@@ -86,7 +87,8 @@ class ReplicaNode:
                  journal_prefix: Optional[str] = None,
                  obs=None, clock=None, table=None,
                  journal=None, wire_enabled: Optional[bool] = None,
-                 snapshot_ops_threshold: Optional[int] = None) -> None:
+                 snapshot_ops_threshold: Optional[int] = None,
+                 group_ttl_s: Optional[float] = None) -> None:
         self.store = store
         self.self_id = self_id
         # clock/table/journal are dependency seams: the model checker
@@ -155,6 +157,26 @@ class ReplicaNode:
             incarnation = self.journal.restored_incarnation() + 1
             self.journal.note_incarnation(incarnation)
             self.leases.restore(self.journal)
+        # writer groups (replicate/writergroup.py): hot-doc write
+        # splitting. Restored after the lease floors so a registration
+        # a journaled floor supersedes is never resurrected; restored
+        # entries come back EXPIRED (accepting again takes a renewal).
+        self.writergroups = WriterGroupTable(
+            self_id,
+            ttl_s=lease_ttl_s * 2 if group_ttl_s is None
+            else group_ttl_s,
+            metrics=self.metrics, clock=self.clock)
+        if self.journal is not None:
+            self.writergroups.restore(
+                self.journal,
+                lambda d: self.leases.max_epoch.get(d, 0))
+        # fencing floor raises fence superseded group registrations in
+        # the same lease-lock critical section (no admit can interleave)
+        self.leases.on_floor_raise = self.writergroups.fence_below
+        # seam for the model checker's demote-without-drain mutation:
+        # the member-side demotion fence drains pending admissions into
+        # the oplog before evicting its queue iff this flag stands
+        self._group_demote_drains = True
         self.membership = MembershipView(self_id, incarnation,
                                          metrics=self.metrics)
         # bootstrap peers start ALIVE (assumed healthy until the probe
@@ -247,6 +269,13 @@ class ReplicaNode:
             self.metrics.bump("fencing", "rejoin_denials")
             self.metrics.bump("merge_gate", "denials")
             return False
+        if self.group_accepts(doc_id):
+            # writer-group member in good standing: admitted locally,
+            # stamped with the group epoch (active_epoch below)
+            self.metrics.bump("merge_gate", "admits")
+            self.metrics.bump("writergroup", "member_admits")
+            self.merged_docs.add(doc_id)
+            return True
         ok = self.leases.ensure_local(
             doc_id, self.desired_owner(doc_id) == self.self_id)
         self.metrics.bump("merge_gate", "admits" if ok else "denials")
@@ -256,8 +285,181 @@ class ReplicaNode:
 
     def active_epoch(self, doc_id: str) -> int:
         """Scheduler fencing callback: epoch of the ACTIVE lease this
-        host holds for the doc, 0 when it holds none."""
-        return self.leases.active_epoch(doc_id)
+        host holds for the doc — or the group epoch when we write as a
+        group member — 0 when neither stands."""
+        epoch = self.leases.active_epoch(doc_id)
+        if epoch:
+            return epoch
+        if self.group_accepts(doc_id):
+            g = self.writergroups.get(doc_id)
+            if g is not None:
+                return g.epoch
+        return 0
+
+    # ---- writer groups (replicate/writergroup.py) ------------------------
+
+    def group_accepts(self, doc_id: str) -> bool:
+        """May this host accept writes for `doc_id` as a writer-group
+        MEMBER? (The leader admits through its own ACTIVE lease.)
+        Pure read — no state is mutated, so the model checker can use
+        it as action enabledness. False once the registration expired
+        un-renewed, once the fencing floor passed the group epoch, or
+        when the leader plus a majority of the group is unreachable —
+        the self-fence: a cut-off member degrades to proxy-only rather
+        than accepting writes the group may already have fenced away."""
+        if self.rejoining:
+            return False
+        g = self.writergroups.get(doc_id)
+        if g is None or g.leader == self.self_id:
+            return False
+        if g.epoch < self.leases.max_epoch_of(doc_id):
+            return False      # belt: fence_below drops these eagerly
+        if self.clock() >= g.expires_at:
+            return False
+        if not self.table.is_healthy(g.leader):
+            return False
+        reach = sum(1 for m in g.members
+                    if m == self.self_id or self.table.is_healthy(m))
+        return reach >= g.quorum_size()
+
+    def promote_writer_group(self, doc_id: str,
+                             members: List[str]) -> bool:
+        """Split `doc_id`'s write path: promote our single ACTIVE lease
+        to a writer group of `members` (us included) at a bumped epoch.
+        The epoch is planned exactly like any acquisition
+        (`max(lease.epoch, floor) + 1`), ratified by a majority promise
+        round, and committed by re-keying our lease; members get a
+        directed group grant whose install raises their fencing floor
+        to the group epoch. A member that misses its grant simply never
+        co-writes — convergence does not depend on it."""
+        if self.rejoining:
+            return False
+        member_set = sorted(set(members) | {self.self_id})
+        if len(member_set) < 2:
+            return False
+        if self.writergroups.get(doc_id) is not None:
+            return False
+        with self.leases.lock:
+            lease = self.leases.leases.get(doc_id)
+            if lease is None or lease.holder != self.self_id \
+                    or lease.state != ACTIVE:
+                return False
+            epoch = max(lease.epoch,
+                        self.leases.max_epoch.get(doc_id, 0)) + 1
+        if not self._run_quorum(doc_id, epoch, False):
+            return False
+        if not self.leases.promote_epoch(doc_id, epoch):
+            return False     # revoked between the round and the rekey
+        self.writergroups.install(
+            doc_id, epoch, member_set, self.self_id,
+            floor=self.leases.max_epoch_of(doc_id))
+        self.metrics.bump("writergroup", "promotions")
+        if self.obs is not None:
+            self.obs.recorder.record("group_promoted", doc=doc_id,
+                                     epoch=epoch, members=member_set)
+        grant = {"action": "group", "doc": doc_id, "epoch": epoch,
+                 "members": member_set, "leader": self.self_id,
+                 "ttl_s": self.writergroups.ttl_s}
+        for m in member_set:
+            if m == self.self_id:
+                continue
+            try:
+                self.table.call_json(m, "/replicate/lease", grant)
+                self.metrics.bump("writergroup", "member_grants")
+            except (OSError, KeyError, ValueError,
+                    urllib.error.HTTPError):
+                continue
+        return True
+
+    def can_demote(self, doc_id: str) -> bool:
+        """Would `demote_writer_group` commit right now? True when we
+        lead the group with an ACTIVE lease and every other member is
+        reachable (drainable) or the registration TTL has provably
+        expired (a silent member can no longer be accepting)."""
+        g = self.writergroups.get(doc_id)
+        if g is None or g.leader != self.self_id:
+            return False
+        lease = self.leases.get(doc_id)
+        if lease is None or lease.holder != self.self_id \
+                or lease.state != ACTIVE:
+            return False
+        if self.clock() >= g.expires_at:
+            return True
+        return all(self.table.is_healthy(m) for m in g.members
+                   if m != self.self_id)
+
+    def demote_writer_group(self, doc_id: str) -> bool:
+        """Drain the group back to a single writer (us) — the
+        robustness centerpiece. The demotion epoch `group_epoch + 1`
+        wins a majority round, every reachable member is fenced (it
+        drains pending admissions into its oplog, drops the
+        registration and evicts its queue), and only then is our lease
+        re-keyed. An unreachable member blocks the demotion until its
+        registration TTL has expired: committing earlier would let a
+        silent-but-alive member keep accepting writes under the
+        superseded epoch."""
+        g = self.writergroups.get(doc_id)
+        if g is None or g.leader != self.self_id:
+            return False
+        now = self.clock()
+        expired = now >= g.expires_at
+        others = [m for m in g.members if m != self.self_id]
+        if not expired:
+            for m in others:
+                if not self.table.is_healthy(m):
+                    self.metrics.bump("writergroup", "demote_aborts")
+                    return False
+        with self.leases.lock:
+            lease = self.leases.leases.get(doc_id)
+            if lease is None or lease.holder != self.self_id \
+                    or lease.state != ACTIVE:
+                return False
+            epoch = max(lease.epoch,
+                        self.leases.max_epoch.get(doc_id, 0)) + 1
+        if not self._run_quorum(doc_id, epoch, False):
+            self.metrics.bump("writergroup", "demote_aborts")
+            return False
+        demote = {"action": "group-demote", "doc": doc_id,
+                  "epoch": epoch, "leader": self.self_id}
+        for m in others:
+            try:
+                self.table.call_json(m, "/replicate/lease", demote)
+            except (OSError, KeyError, ValueError,
+                    urllib.error.HTTPError):
+                # unreachable member: its registration is past TTL
+                # (checked above) or fenced by the quorum round's
+                # floor raise the moment it reconnects
+                continue
+        if not self.leases.promote_epoch(doc_id, epoch):
+            self.metrics.bump("writergroup", "demote_aborts")
+            return False
+        self.writergroups.drop(doc_id)
+        self.metrics.bump("writergroup", "demotions")
+        if self.obs is not None:
+            self.obs.recorder.record("group_demoted", doc=doc_id,
+                                     epoch=epoch)
+        return True
+
+    def _group_fence_local(self, doc_id: str,
+                           epoch: Optional[int] = None) -> bool:
+        """Member-side demotion fence: drain pending admissions into
+        the oplog, drop the registration (only at or below `epoch` — a
+        replayed demote must not fence a newer group), and evict the
+        admission queue. The drain barrier is what `no-acked-loss`
+        guards: eviction without it discards acked work (the
+        demote-without-drain seeded mutation)."""
+        g = self.writergroups.get(doc_id)
+        if g is not None and epoch is not None and g.epoch > epoch:
+            return False
+        if self._group_demote_drains:
+            sched = getattr(self.store, "scheduler", None)
+            if sched is not None:
+                sched.drain()
+        self.writergroups.drop(doc_id, at_or_below=epoch)
+        pending = getattr(self.store, "pending", None)
+        if pending is not None:
+            pending.pop(doc_id, None)
+        return True
 
     def route_mutation(self, doc_id: str) -> str:
         """The host a write for `doc_id` should land on."""
@@ -490,12 +692,59 @@ class ReplicaNode:
             if ok:
                 self._pin_migrated_doc(doc_id)
             return {"ok": ok}
+        if action == "group":
+            # writer-group grant (leader -> member): fold the leader's
+            # lease claim at the group epoch FIRST — that raises our
+            # fencing floor to it — then register. A replayed grant
+            # from a superseded group fails the install's floor check.
+            members = req.get("members")
+            leader = req.get("leader")
+            if not isinstance(leader, str) or not leader \
+                    or not isinstance(members, list) \
+                    or self.self_id not in members:
+                return {"ok": False, "error": "bad group"}
+            self.leases.observe_remote(doc_id, leader, epoch, ACTIVE,
+                                       float(req.get("ttl_s", 0.0)))
+            ok = self.writergroups.install(
+                doc_id, epoch, [str(m) for m in members], leader,
+                floor=self.leases.max_epoch_of(doc_id))
+            if ok:
+                self.metrics.bump("writergroup", "member_grants")
+            else:
+                self.metrics.bump("writergroup",
+                                  "stale_installs_rejected")
+            return {"ok": ok}
+        if action == "group-renew":
+            # member -> leader: extend the member's registration while
+            # the group at that epoch is still current on our side
+            member = req.get("member")
+            g = self.writergroups.get(doc_id)
+            if g is None or g.leader != self.self_id \
+                    or g.epoch != epoch or member not in g.members:
+                self.metrics.bump("writergroup", "renewal_denials")
+                return {"ok": False}
+            self.writergroups.refresh(doc_id, epoch)
+            self.metrics.bump("writergroup", "renewals")
+            return {"ok": True, "ttl_s": self.writergroups.ttl_s}
+        if action == "group-demote":
+            # leader -> member: the demotion epoch has won its quorum
+            # round. Raise our floor to it (the promise is idempotent;
+            # a refusal means the floor already passed it) and fence:
+            # drain, drop the registration, evict the queue.
+            leader = req.get("leader")
+            if isinstance(leader, str) and leader:
+                self.leases.promise(doc_id, epoch, leader)
+            self._group_fence_local(doc_id, epoch - 1)
+            return {"ok": True}
         if action == "status":
             lease = self.leases.get(doc_id)
+            g = self.writergroups.get(doc_id)
             return {"ok": True,
                     "lease": lease.as_json() if lease else None,
                     "desired": self.desired_owner(doc_id),
                     "max_epoch": self.leases.max_epoch_of(doc_id),
+                    "group": g.as_json(self.clock())
+                    if g is not None else None,
                     "rejoining": self.rejoining}
         return {"ok": False, "error": f"bad action {action!r}"}
 
@@ -711,21 +960,74 @@ class ReplicaNode:
         docs whose rendezvous owner moved to a healthy peer.
         Serialized (probe loop + manual test calls must not race two
         handoffs for one doc)."""
-        out = {"renewed": 0, "handoffs": 0}
+        out = {"renewed": 0, "handoffs": 0, "group_renewed": 0,
+               "group_demotions": 0, "group_fenced": 0}
         with self._maintain_lock:
             self._sync_membership()
             self._rejoin_check()
             if self.rejoining:
                 return out
             for doc_id in self.leases.held_ids():
+                # a doc we lead a writer group for must NOT hand off on
+                # rendezvous drift — the group is pinned to its leader;
+                # demotion is the only exit
+                g = self.writergroups.get(doc_id)
                 desired = self.desired_owner(doc_id)
-                if desired == self.self_id:
+                if desired == self.self_id or (
+                        g is not None and g.leader == self.self_id):
                     self.leases.ensure_local(doc_id, True)
                     out["renewed"] += 1
                 elif self.table.is_healthy(desired):
                     if self.handoff(doc_id, desired):
                         out["handoffs"] += 1
+            self._group_maintain(out)
         return out
+
+    def _group_maintain(self, out: dict) -> None:
+        """Writer-group upkeep on the maintain tick. Leaders demote
+        groups with a crashed/partitioned member (the demote itself
+        waits out the registration TTL when the member is silent — no
+        operator action either way). Members renew their registration
+        through the leader and self-fence once it expired un-renewed."""
+        for doc_id, g in self.writergroups.entries():
+            if g.leader == self.self_id:
+                # member renewals are the group's liveness signal: an
+                # expired registration means no member renewed for a
+                # whole TTL even if probes look healthy (the asymmetric
+                # partition — members can't reach us, we still hear
+                # them), so it demotes exactly like an unhealthy member
+                if self.clock() >= g.expires_at \
+                        or any(not self.table.is_healthy(m)
+                               for m in g.members
+                               if m != self.self_id):
+                    if self.demote_writer_group(doc_id):
+                        out["group_demotions"] += 1
+                continue
+            renewed = False
+            if self.table.is_healthy(g.leader):
+                try:
+                    resp = self.table.call_json(
+                        g.leader, "/replicate/lease",
+                        {"action": "group-renew", "doc": doc_id,
+                         "epoch": g.epoch, "member": self.self_id})
+                except (OSError, KeyError, ValueError,
+                        urllib.error.HTTPError):
+                    resp = None
+                if resp is not None and resp.get("ok"):
+                    self.writergroups.refresh(doc_id, g.epoch)
+                    out["group_renewed"] += 1
+                    renewed = True
+                elif resp is not None:
+                    # the leader no longer recognizes this group (it
+                    # demoted, re-acquired, or restarted): fence now
+                    self._group_fence_local(doc_id, g.epoch)
+                    self.metrics.bump("writergroup", "self_fenced")
+                    out["group_fenced"] += 1
+                    continue
+            if not renewed and self.clock() >= g.expires_at:
+                self._group_fence_local(doc_id, g.epoch)
+                self.metrics.bump("writergroup", "self_fenced")
+                out["group_fenced"] += 1
 
     # ---- docs listing (for anti-entropy peers) ---------------------------
 
@@ -788,7 +1090,8 @@ class ReplicaNode:
             quorum_view={"voters": self.membership.voters(),
                          "quorum": self.membership.quorum_size(),
                          "rejoining": self.rejoining},
-            override_table_size=self.overrides.size())
+            override_table_size=self.overrides.size(),
+            writergroup_sizes=self.writergroups.sizes())
 
     # ---- lifecycle -------------------------------------------------------
 

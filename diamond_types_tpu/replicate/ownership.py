@@ -150,6 +150,11 @@ class LeaseManager:
         # persists floors/promises/held leases across restarts
         self.quorum: Optional[Callable[[str, int, bool], bool]] = None
         self.journal = None
+        # floor-raise hook (wired by node.ReplicaNode to
+        # WriterGroupTable.fence_below): called UNDER self.lock every
+        # time a doc's fencing floor rises, so group registrations the
+        # new floor supersedes are fenced in the same critical section
+        self.on_floor_raise: Optional[Callable[[str, int], None]] = None
         # obs.recorder.FlightRecorder (wired by node.ReplicaNode);
         # every lease transition is rare enough to record
         self.recorder = None
@@ -178,6 +183,8 @@ class LeaseManager:
             self.max_epoch[doc_id] = epoch
             if self.journal is not None:
                 self.journal.note_epoch(doc_id, epoch)
+            if self.on_floor_raise is not None:
+                self.on_floor_raise(doc_id, epoch)
 
     def _log_activation_locked(self, doc_id: str, epoch: int) -> None:
         self.activation_log.append(
@@ -357,6 +364,30 @@ class LeaseManager:
             self._bump("takeovers" if takeover else "acquires")
             self._event("lease_acquired", doc_id, epoch,
                         takeover=takeover)
+            if self.journal is not None:
+                self.journal.note_lease(doc_id, self.self_id, epoch,
+                                        ACTIVE)
+            return True
+
+    def promote_epoch(self, doc_id: str, epoch: int) -> bool:
+        """Writer-group rekey: move our own ACTIVE lease to `epoch` — a
+        strictly higher, quorum-ratified bump — without ever leaving
+        ACTIVE. Promotion registers the member set at the new epoch;
+        demotion bumps once more so every member grant below it is
+        fenced by the ordinary floor machinery. The caller MUST have
+        won the quorum round for `epoch` first (node-level), exactly
+        like a handoff activation."""
+        now = self.clock()
+        with self.lock:
+            lease = self.leases.get(doc_id)
+            if lease is None or lease.holder != self.self_id \
+                    or lease.state != ACTIVE or epoch <= lease.epoch:
+                return False
+            lease.epoch = epoch
+            lease.expires_at = now + self.ttl_s
+            self._note_epoch_locked(doc_id, epoch)
+            self._log_activation_locked(doc_id, epoch)
+            self._event("lease_rekeyed", doc_id, epoch)
             if self.journal is not None:
                 self.journal.note_lease(doc_id, self.self_id, epoch,
                                         ACTIVE)

@@ -68,6 +68,9 @@ class ReplicaJournal:
       {"t": "drop_lease", "doc": str}
       {"t": "override", "doc": str, "target": str | null, "ver": int}
                                     # placement override (null = tombstone)
+      {"t": "group", "doc": str, "epoch": int, "members": [str],
+       "leader": str}               # writer-group registration
+      {"t": "drop_group", "doc": str}
 
     Promises are persisted because they are the safety core: a voter
     that promised (doc, E) to A, crashed, and forgot could promise
@@ -138,6 +141,13 @@ class ReplicaJournal:
                 "state": rec.get("state", "active")}
         elif t == "drop_lease":
             self.state.setdefault("leases", {}).pop(rec["doc"], None)
+        elif t == "group":
+            self.state.setdefault("groups", {})[rec["doc"]] = {
+                "epoch": int(rec["epoch"]),
+                "members": list(rec.get("members", [])),
+                "leader": rec.get("leader", "")}
+        elif t == "drop_group":
+            self.state.setdefault("groups", {}).pop(rec["doc"], None)
         elif t == "override":
             # last-writer-wins by version, matching
             # rebalance.PlacementOverrides.merge (tombstones kept — a
@@ -196,6 +206,13 @@ class ReplicaJournal:
         self.record({"t": "override", "doc": doc, "target": target,
                      "ver": int(ver)})
 
+    def note_group(self, doc: str, epoch: int, members, leader: str) -> None:
+        self.record({"t": "group", "doc": doc, "epoch": int(epoch),
+                     "members": list(members), "leader": leader})
+
+    def drop_group(self, doc: str) -> None:
+        self.record({"t": "drop_group", "doc": doc})
+
     # ---- restored views --------------------------------------------------
 
     def restored_incarnation(self) -> int:
@@ -214,12 +231,16 @@ class ReplicaJournal:
     def restored_overrides(self) -> Dict[str, dict]:
         return dict(self.state.get("overrides", {}))
 
+    def restored_groups(self) -> Dict[str, dict]:
+        return dict(self.state.get("groups", {}))
+
     def has_prior_state(self) -> bool:
         return bool(self.state.get("incarnation", 0)
                     or self.state.get("max_epoch")
                     or self.state.get("leases")
                     or self.state.get("promises")
-                    or self.state.get("overrides"))
+                    or self.state.get("overrides")
+                    or self.state.get("groups"))
 
     def close(self) -> None:
         with self._lock:

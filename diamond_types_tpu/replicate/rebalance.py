@@ -198,7 +198,12 @@ class Rebalancer:
                  top_n: int = 4,
                  min_load_gap: int = 1,
                  act_on: Tuple[str, ...] = ("warning", "burning"),
-                 enabled: bool = True) -> None:
+                 enabled: bool = True,
+                 split_hot_docs: bool = False,
+                 group_size: int = 2,
+                 promote_after_ticks: int = 2,
+                 promote_min_share: float = 0.5,
+                 demote_after_s: float = 6.0) -> None:
         self.node = node
         self.obs = obs if obs is not None else getattr(node, "obs",
                                                        None)
@@ -213,9 +218,29 @@ class Rebalancer:
         # move a doc
         self.act_on = tuple(act_on)
         self.enabled = enabled
+        # hot-doc write splitting (replicate/writergroup.py): when a
+        # held doc stays a top offender for `promote_after_ticks`
+        # consecutive stressed ticks, promote it to a writer group of
+        # `group_size` instead of migrating it (a flash crowd on ONE
+        # doc cannot be migrated away — splitting the write path can).
+        # Cooled groups demote after `demote_after_s` without burn.
+        # OFF by default: the single-writer path stays byte-identical.
+        self.split_hot_docs = split_hot_docs
+        self.group_size = max(2, int(group_size))
+        self.promote_after_ticks = max(1, int(promote_after_ticks))
+        # splitting is for a DOMINANT doc: promotion also requires the
+        # doc to carry at least this share of the attributed burn, so
+        # merely ranking in the top-N (which migration is happy with)
+        # never splits a cold doc
+        self.promote_min_share = float(promote_min_share)
+        self.demote_after_s = demote_after_s
         self._rebalance_lock = make_lock("repl.rebalance.plan",
                                          "repl.rebalance")
         self._last_attempt: Dict[str, float] = {}
+        # doc -> consecutive stressed ticks it ranked as an offender
+        self._hot_ticks: Dict[str, int] = {}
+        # doc -> last time a group we lead saw hot-doc burn
+        self._group_hot: Dict[str, float] = {}
 
     # ---- selection -------------------------------------------------------
 
@@ -230,14 +255,8 @@ class Rebalancer:
         return [r["name"] for r in rows
                 if r.get("state") in self.act_on]
 
-    def _offenders(self) -> List[str]:
-        """This host's held docs ranked by hot-doc attribution score
-        (ops + bytes sketches merged); falls back to held order when
-        the sketch is cold so a burning host can still shed load."""
-        node = self.node
-        held = list(node.leases.held_ids())
-        if not held:
-            return []
+    def _attrib_scores(self) -> Dict[str, float]:
+        """Per-doc hot-doc attribution (ops + bytes sketches merged)."""
         scores: Dict[str, float] = {}
         attrib = getattr(self.obs, "attrib", None) \
             if self.obs is not None else None
@@ -246,6 +265,19 @@ class Rebalancer:
                 for key, count, _err in attrib.top("doc", kind,
                                                    self.top_n * 4):
                     scores[key] = scores.get(key, 0.0) + count
+        return scores
+
+    def _offenders(self, scores: Optional[Dict[str, float]] = None
+                   ) -> List[str]:
+        """This host's held docs ranked by hot-doc attribution score
+        (ops + bytes sketches merged); falls back to held order when
+        the sketch is cold so a burning host can still shed load."""
+        node = self.node
+        held = list(node.leases.held_ids())
+        if not held:
+            return []
+        if scores is None:
+            scores = self._attrib_scores()
         held.sort(key=lambda d: (-scores.get(d, 0.0), d))
         return held[:self.top_n]
 
@@ -265,6 +297,18 @@ class Rebalancer:
             if best is None or (load, m) < best:
                 best = (load, m)
         return best[1] if best is not None else None
+
+    def _pick_members(self, n: int) -> List[str]:
+        """Up to `n` co-writer candidates, least-loaded first. Unlike
+        `_pick_target` there is no load-gap damper: splitting does not
+        move the doc, it only shares its write path, so any healthy
+        peer helps."""
+        node = self.node
+        ranked = sorted(
+            (int(node.peer_load.get(m, 0)), m)
+            for m in node.membership.universe()
+            if m != node.self_id and node.table.is_healthy(m))
+        return [m for _load, m in ranked[:n]]
 
     # ---- migration -------------------------------------------------------
 
@@ -315,25 +359,80 @@ class Rebalancer:
 
     def tick(self) -> dict:
         """One control-loop evaluation. Returns a small report dict
-        (soaks fold it into their round logs)."""
-        out = {"stressed": [], "migrated": [], "aborted": []}
+        (soaks fold it into their round logs). Planning happens under
+        the rebalance lock; migrations AND group promotions/demotions
+        (network + lease lock) run strictly outside it."""
+        out = {"stressed": [], "migrated": [], "aborted": [],
+               "promoted": [], "demoted": []}
         if not self.enabled or self.node.rejoining:
             return out
         plan: List[Tuple[str, str]] = []
+        promote_plan: List[Tuple[str, List[str]]] = []
+        demote_plan: List[str] = []
+        node = self.node
+        groups = getattr(node, "writergroups", None)
         with self._rebalance_lock:
             stressed = self._stressed()
             out["stressed"] = stressed
+            now = node.clock()
+            scores = self._attrib_scores() if stressed else {}
+            offenders = self._offenders(scores) if stressed else []
+            led = {d for d, g in groups.entries()
+                   if g.leader == node.self_id} \
+                if groups is not None else set()
+            if self.split_hot_docs and groups is not None:
+                total = sum(scores.values())
+                hot = {d for d in offenders
+                       if total > 0.0 and scores.get(d, 0.0)
+                       >= self.promote_min_share * total}
+                for d in list(self._hot_ticks):
+                    if d not in hot:
+                        self._hot_ticks.pop(d, None)
+                for doc_id in sorted(hot):
+                    if doc_id in led:
+                        self._group_hot[doc_id] = now
+                        continue
+                    ticks = self._hot_ticks.get(doc_id, 0) + 1
+                    self._hot_ticks[doc_id] = ticks
+                    if ticks >= self.promote_after_ticks:
+                        members = self._pick_members(
+                            self.group_size - 1)
+                        if members:
+                            promote_plan.append((doc_id, members))
+                for doc_id in sorted(led):
+                    if doc_id in hot:
+                        continue
+                    last = self._group_hot.get(doc_id, 0.0)
+                    if now - last >= self.demote_after_s:
+                        demote_plan.append(doc_id)
             if stressed:
                 target = self._pick_target()
                 if target is not None:
-                    now = self.node.clock()
-                    for doc_id in self._offenders():
+                    # group-led docs are pinned to their leader, and a
+                    # doc accumulating toward promotion splits rather
+                    # than migrates — moving the burn is not fixing it
+                    skip = led | {d for d, _m in promote_plan}
+                    if self.split_hot_docs:
+                        skip |= set(self._hot_ticks)
+                    for doc_id in offenders:
                         if len(plan) >= self.max_migrations_per_tick:
                             break
+                        if doc_id in skip:
+                            continue    # group-led docs are pinned
                         last = self._last_attempt.get(doc_id, 0.0)
                         if now - last < self.cooldown_s:
                             continue
                         plan.append((doc_id, target))
+        for doc_id, members in promote_plan:
+            if node.promote_writer_group(doc_id, members):
+                out["promoted"].append([doc_id, members])
+                self._group_hot[doc_id] = node.clock()
+                self._hot_ticks.pop(doc_id, None)
+        for doc_id in demote_plan:
+            if node.can_demote(doc_id) \
+                    and node.demote_writer_group(doc_id):
+                out["demoted"].append(doc_id)
+                self._group_hot.pop(doc_id, None)
         for doc_id, target in plan:
             if self.migrate(doc_id, target):
                 out["migrated"].append([doc_id, target])

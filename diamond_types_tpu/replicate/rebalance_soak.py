@@ -400,6 +400,397 @@ def run_rebalance_soak(servers: int = 3, docs: int = 8, seed: int = 7,
     return report
 
 
+def run_split_soak(servers: int = 3, docs: int = 4, seed: int = 11,
+                   capacity_per_round: int = 4,
+                   offered_per_round: int = 10,
+                   measure_rounds: int = 6,
+                   lease_ttl_s: float = 30.0,
+                   group_ttl_s: float = 1.5,
+                   fast_window_s: float = 3.0,
+                   slow_window_s: float = 6.0,
+                   progress: bool = False) -> dict:
+    """Hot-doc write-splitting soak (CLI: `rebalance-soak
+    --split-hot-doc`).
+
+    The single-writer wall: every hot-doc write must be APPLIED at the
+    one lease holder — writes ingested elsewhere are proxied to it —
+    so one host's apply capacity caps the doc no matter how many peers
+    idle. Like the flash-crowd soak's RTT model, capacity is modeled
+    explicitly (`capacity_per_round` applied writes per WRITER host per
+    control round, offered load above it); every admitted write is a
+    REAL HTTP edit with a unique marker, so convergence, acked-loss
+    and split-brain are checked for real, not modeled.
+
+    Phases, all driven by the closed loop (no operator action):
+
+      * single-writer baseline — offered load arrives at two ingress
+        hosts; the non-owner PROXIES (its merge gate admits nothing),
+        so per-round admission is 1x capacity;
+      * promotion — sustained hot-doc burn makes the REBALANCER
+        promote the doc to a {leader, member} writer group;
+      * split measurement — the same two ingress hosts now BOTH accept
+        locally (the member's merge gate admits under the group
+        epoch): per-round admission is 2x capacity — the >= 2x
+        throughput gate — while raw wall-clock rates are reported
+        unmodeled alongside;
+      * member-crash — the member is isolated from the whole mesh
+        (mesh-indistinguishable from a crash): it must self-fence to
+        proxy-only immediately, and the leader must demote once the
+        registration TTL has provably expired;
+      * partition-minority — after re-promotion, an ASYMMETRIC cut
+        (member cannot reach the leader, the leader still hears the
+        member): renewals fail, the member self-fences on expiry, the
+        leader's un-renewed registration expires and demotes cleanly.
+
+    Exit-0 verdict: promotion and both demotions happened without
+    operator action, admission scaled >= 2x with 2 writers, every
+    acked marker is present on every server byte-identically, and the
+    activation-history scan found zero split-brain."""
+    from ..tools.server import SyncClient, serve
+    from .faults import FaultInjector
+
+    rng = random.Random(seed)
+    doc_ids = [f"split-{i}" for i in range(docs)]
+    faults = FaultInjector(seed=seed)
+    obs_opts = dict(sample_rate=1.0, ts_window_s=0.5, ts_windows=64,
+                    objectives=[_objective(fast_window_s,
+                                           slow_window_s)])
+    node_opts = dict(seed=seed, lease_ttl_s=lease_ttl_s,
+                     group_ttl_s=group_ttl_s, faults=faults,
+                     probe_interval_s=0.25,
+                     antientropy_interval_s=0.25,
+                     timeout_s=2.0, backoff_base_s=0.02,
+                     backoff_cap_s=0.1)
+    # demote_after_s is pushed out of soak range on purpose: the two
+    # demotions under test are the FAULT paths (maintain-loop demote on
+    # an unhealthy member after TTL), not cooled load
+    rb_opts = dict(cooldown_s=0.2, max_migrations_per_tick=1,
+                   min_load_gap=2, top_n=4,
+                   act_on=("warning", "burning"),
+                   split_hot_docs=True, group_size=2,
+                   promote_after_ticks=2, demote_after_s=300.0)
+
+    httpds: List = []
+    nodes: List = []
+    addrs: List[str] = []
+    for i in range(servers):
+        httpd = serve(port=0, serve_shards=1, follower_reads=True,
+                      obs_opts=dict(obs_opts))
+        httpd.socket.listen(128)
+        httpds.append(httpd)
+        addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
+    for i, httpd in enumerate(httpds):
+        node = attach_replication(
+            httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+            **node_opts)
+        attach_rebalancer(node, **rb_opts)
+        nodes.append(node)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+
+    promotions: List[List] = []
+    demotions: List[str] = []
+
+    def step_control_plane() -> None:
+        for n in nodes:
+            n.table.probe_once()
+            n.maintain()
+        for n in nodes:
+            rep = n.rebalancer.tick()
+            promotions.extend(rep["promoted"])
+            demotions.extend(rep["demoted"])
+        for n in nodes:
+            n.antientropy.run_round()
+
+    clients: Dict[tuple, SyncClient] = {}
+
+    def client(addr: str, doc_id: str) -> SyncClient:
+        key = (addr, doc_id)
+        if key not in clients:
+            clients[key] = SyncClient(
+                f"http://{addr}", doc_id,
+                f"agent-{addr}-{doc_id}", retries=2)
+        return clients[key]
+
+    acked_markers: List[Tuple[str, str]] = []   # (doc_id, marker)
+    marker_seq = 0
+
+    def write(addr: str, doc_id: str) -> bool:
+        nonlocal marker_seq
+        marker = f"w{marker_seq}."
+        marker_seq += 1
+        c = client(addr, doc_id)
+        try:
+            c.pull()
+        except OSError:
+            pass
+        # always PREPEND: concurrent inserts at position 0 order
+        # themselves but can never split an existing marker run, so
+        # the acked-loss scan's substring check stays sound under
+        # two-writer concurrency
+        c.insert(0, marker + " ")
+        try:
+            c.sync()
+        except OSError:
+            return False
+        acked_markers.append((doc_id, marker))
+        return True
+
+    def owner_of(doc_id: str):
+        holders = [n for n in nodes
+                   if n.leases.active_epoch(doc_id) > 0]
+        return holders[0] if len(holders) == 1 else None
+
+    t0 = time.monotonic()
+
+    # ---- seed + settle ----------------------------------------------------
+    for doc_id in doc_ids:
+        write(addrs[rng.randrange(servers)], doc_id)
+    for _ in range(40):
+        step_control_plane()
+        if all(owner_of(d) is not None for d in doc_ids):
+            break
+        time.sleep(0.02)
+    settled = all(owner_of(d) is not None for d in doc_ids)
+    hot_doc = doc_ids[0]
+    leader = owner_of(hot_doc)
+    if leader is None:
+        leader = nodes[0]
+    # the co-writer the rebalancer will pick (same selection code)
+    picked = leader.rebalancer._pick_members(1)
+    member_addr = picked[0] if picked else \
+        next(a for a in addrs if a != leader.self_id)
+    member = next(n for n in nodes if n.self_id == member_addr)
+    ingress = [leader.self_id, member_addr]
+
+    def measure_phase(writers: int):
+        """`measure_rounds` control rounds of the capacity model:
+        offered load round-robins across both ingress hosts, the first
+        `capacity_per_round * writers` writes per round are applied as
+        real HTTP edits, the rest are deferred (capacity, not
+        transport, is the modeled limit)."""
+        acked = 0
+        deferred = 0
+        t = time.monotonic()
+        for _ in range(measure_rounds):
+            cap = capacity_per_round * writers
+            for i in range(offered_per_round):
+                if i >= cap:
+                    deferred += 1
+                    continue
+                if write(ingress[i % 2], hot_doc):
+                    acked += 1
+            step_control_plane()
+        return acked, deferred, time.monotonic() - t
+
+    # ---- single-writer baseline -------------------------------------------
+    member_admits_0 = member.metrics.get("writergroup", "member_admits")
+    single_acked, single_deferred, single_wall = measure_phase(1)
+    single_member_admits = member.metrics.get(
+        "writergroup", "member_admits") - member_admits_0
+
+    # ---- promotion under sustained burn -----------------------------------
+    promoted = False
+    for r in range(40):
+        leader.obs.ts.observe("soak.edit_rtt", _RTT_BAD_S)
+        leader.obs.attrib.note("ops", doc=hot_doc, n=float(_W_HOT))
+        step_control_plane()
+        g = leader.writergroups.get(hot_doc)
+        if g is not None and g.leader == leader.self_id:
+            promoted = True
+            break
+        time.sleep(0.02)
+    g = leader.writergroups.get(hot_doc)
+    group_members = list(g.members) if g is not None else []
+    member_in_group = member_addr in group_members
+    # let the burn windows drain so the measured phase is load-model
+    # only (and the member's registration is renewed at least once)
+    for _ in range(4):
+        leader.obs.ts.observe("soak.edit_rtt", _RTT_GOOD_S)
+        step_control_plane()
+        time.sleep(0.02)
+
+    # ---- split measurement ------------------------------------------------
+    member_admits_1 = member.metrics.get("writergroup", "member_admits")
+    group_acked, group_deferred, group_wall = measure_phase(2)
+    group_member_admits = member.metrics.get(
+        "writergroup", "member_admits") - member_admits_1
+
+    speedup = (group_acked / measure_rounds) \
+        / max(single_acked / measure_rounds, 1e-9)
+    rate_single = single_acked / max(single_wall, 1e-9)
+    rate_group = group_acked / max(group_wall, 1e-9)
+
+    def demote_phase(mem, cut: List[tuple], oneway: bool) -> dict:
+        """Inject the cut, require the member to self-fence and the
+        leader to demote (TTL-gated, closed loop), then heal."""
+        for a, b in cut:
+            faults.partition(a, b, oneway=oneway)
+        self_fenced = False
+        demoted = False
+        # count demotions instead of polling for a missing entry: the
+        # still-hot rebalancer may legally re-promote (with a healthy
+        # co-writer) between our observations
+        d0 = leader.metrics.get("writergroup", "demotions")
+        deadline = time.monotonic() + max(group_ttl_s * 8, 8.0)
+        while time.monotonic() < deadline:
+            step_control_plane()
+            self_fenced = self_fenced \
+                or not mem.group_accepts(hot_doc)
+            if leader.metrics.get("writergroup", "demotions") > d0:
+                demoted = True
+                break
+            time.sleep(0.05)
+        # the member's registration must be gone BEFORE the heal
+        # (self-fence on expiry, or the leader's demote fence); after
+        # the heal a still-hot rebalancer may legally re-grant one
+        entry_gone = mem.writergroups.get(hot_doc) is None
+        if not entry_gone:
+            for _ in range(20):
+                step_control_plane()
+                if mem.writergroups.get(hot_doc) is None:
+                    entry_gone = True
+                    break
+                time.sleep(0.02)
+        self_fenced = self_fenced or not mem.group_accepts(hot_doc)
+        faults.heal()
+        for _ in range(6):
+            step_control_plane()
+            time.sleep(0.02)
+        return {"self_fenced": bool(self_fenced),
+                "leader_demoted": demoted,
+                "member_entry_gone": entry_gone,
+                "owner_active": owner_of(hot_doc) is leader}
+
+    # ---- member-crash: full isolation -------------------------------------
+    crash_phase = None
+    if promoted:
+        crash_phase = demote_phase(
+            member,
+            [(member_addr, a) for a in addrs if a != member_addr],
+            oneway=False)
+
+    # ---- partition-minority: asymmetric member->leader cut ----------------
+    repromoted = False
+    minority_phase = None
+    if promoted and crash_phase is not None:
+        member2 = None
+        for r in range(40):
+            leader.obs.ts.observe("soak.edit_rtt", _RTT_BAD_S)
+            leader.obs.attrib.note("ops", doc=hot_doc, n=float(_W_HOT))
+            step_control_plane()
+            g2 = leader.writergroups.get(hot_doc)
+            if g2 is not None and g2.leader == leader.self_id:
+                repromoted = True
+                others = [m for m in g2.members
+                          if m != leader.self_id]
+                member2 = next(n for n in nodes
+                               if n.self_id == others[0])
+                break
+            time.sleep(0.02)
+        if repromoted and member2 is not None:
+            minority_phase = demote_phase(
+                member2, [(member2.self_id, leader.self_id)],
+                oneway=True)
+
+    # ---- wind-down: cooled-load demotion ----------------------------------
+    # stop the burn and let the rebalancer's cooled-load path drain any
+    # still-standing group (the closed loop end to end). Re-promotion
+    # is blocked by an unreachable tick floor rather than by disabling
+    # the policy, so the demote plan stays armed.
+    for n in nodes:
+        n.rebalancer.promote_after_ticks = 10 ** 9
+        n.rebalancer.demote_after_s = 0.0
+    winddown_rounds = None
+    for r in range(200):
+        leader.obs.ts.observe("soak.edit_rtt", _RTT_GOOD_S)
+        step_control_plane()
+        if all(not n.writergroups.entries() for n in nodes):
+            winddown_rounds = r + 1
+            break
+        time.sleep(0.02)
+
+    # ---- reconcile + verdict ----------------------------------------------
+    converged_after = None
+    for r in range(40):
+        step_control_plane()
+        if _converged(addrs, doc_ids):
+            converged_after = r + 1
+            break
+        time.sleep(0.05)
+    texts = _final_texts(addrs, doc_ids)
+    converged = all(len(set(v.values())) == 1 for v in texts.values())
+    split_brain = _split_brain(nodes)
+    lost = sorted(
+        m for d, m in acked_markers
+        if not texts.get(d)
+        or any(m not in t for t in texts[d].values()))
+    groups_clear = all(not n.writergroups.entries() for n in nodes)
+
+    throughput_ok = (
+        single_member_admits == 0          # baseline really proxied
+        and group_member_admits > 0        # split really local-accepts
+        and speedup >= 2.0)
+    demotes_ok = (
+        crash_phase is not None
+        and all(crash_phase.values())
+        and minority_phase is not None
+        and all(minority_phase.values()))
+    ok = bool(settled and promoted and member_in_group
+              and throughput_ok and repromoted and demotes_ok
+              and converged and not lost and not split_brain
+              and groups_clear)
+
+    report = {
+        "config": {"servers": servers, "docs": docs, "seed": seed,
+                   "capacity_per_round": capacity_per_round,
+                   "offered_per_round": offered_per_round,
+                   "measure_rounds": measure_rounds,
+                   "group_ttl_s": group_ttl_s,
+                   "lease_ttl_s": lease_ttl_s},
+        "settled": settled,
+        "hot_doc": hot_doc,
+        "leader": getattr(leader, "self_id", None),
+        "member": member_addr,
+        "promoted": promoted,
+        "group_members": group_members,
+        "single_writer": {
+            "acked": single_acked, "deferred": single_deferred,
+            "wall_s": round(single_wall, 3),
+            "rate_per_s": round(rate_single, 1),
+            "member_admits": single_member_admits},
+        "writer_group": {
+            "acked": group_acked, "deferred": group_deferred,
+            "wall_s": round(group_wall, 3),
+            "rate_per_s": round(rate_group, 1),
+            "member_admits": group_member_admits},
+        "speedup": round(speedup, 3),
+        "throughput_ok": throughput_ok,
+        "member_crash": crash_phase,
+        "repromoted": repromoted,
+        "partition_minority": minority_phase,
+        "rebalancer_promotions": promotions,
+        "rebalancer_demotions": demotions,
+        "acked_markers": len(acked_markers),
+        "lost_markers": lost,
+        "converged": converged,
+        "winddown_rounds": winddown_rounds,
+        "converged_after_reconcile_rounds": converged_after,
+        "split_brain": split_brain,
+        "zero_split_brain": not split_brain,
+        "groups_clear": groups_clear,
+        "faults": faults.snapshot(),
+        "wall_s": round(time.monotonic() - t0, 3),
+        "metrics": {n.self_id:
+                    n.metrics_json()["writergroup"] for n in nodes},
+        "ok": ok,
+    }
+    for httpd in httpds:
+        httpd.shutdown()
+        httpd.server_close()
+    return report
+
+
 def main(argv=None) -> int:  # pragma: no cover - exercised via cli.py
     import argparse
     p = argparse.ArgumentParser(prog="rebalance-soak")

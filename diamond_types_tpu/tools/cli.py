@@ -337,8 +337,49 @@ def cmd_rebalance_soak(args) -> int:
     the SLO burns, and the rebalancer must migrate the doc (epoch-
     fenced handoff + placement override), absorb a mid-run join, roll
     back a seeded failed migration, and return the SLO to ok — all
-    without operator action (see replicate/rebalance_soak.py)."""
+    without operator action (see replicate/rebalance_soak.py).
+
+    With --split-hot-doc, runs the writer-group arm instead: the
+    rebalancer promotes the hot doc to a 2-writer group under
+    sustained burn (>= 2x admission, member accepting locally), then
+    member-crash and asymmetric-partition demotions must drain back to
+    one writer cleanly with zero acked-loss and zero split-brain."""
     from ..replicate.rebalance_soak import run_rebalance_soak
+    if args.split_hot_doc:
+        from ..replicate.rebalance_soak import run_split_soak
+        report = run_split_soak(servers=args.servers, seed=args.seed,
+                                progress=args.progress)
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                json.dump(report, f, indent=1)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            s, g = report["single_writer"], report["writer_group"]
+            print(f"rebalance-soak --split-hot-doc: "
+                  f"{report['config']['servers']} servers, "
+                  f"hot doc {report['hot_doc']}: "
+                  f"single {s['acked']} acked "
+                  f"({s['rate_per_s']}/s) -> group {g['acked']} "
+                  f"acked ({g['rate_per_s']}/s), "
+                  f"speedup {report['speedup']}x, "
+                  f"member-crash demote "
+                  + ("OK" if report["member_crash"]
+                     and all(report["member_crash"].values())
+                     else "BROKEN")
+                  + ", partition-minority demote "
+                  + ("OK" if report["partition_minority"]
+                     and all(report["partition_minority"].values())
+                     else "BROKEN")
+                  + f", acked-loss: {len(report['lost_markers'])}"
+                  + ", split-brain: "
+                  + ("NONE" if report["zero_split_brain"]
+                     else ",".join(report["split_brain"]))
+                  + f" in {report['wall_s']}s: "
+                  + ("CONVERGED" if report["converged"]
+                     else "DIVERGED")
+                  + (" OK" if report["ok"] else " FAILED"))
+        return 0 if report["ok"] else 1
     report = run_rebalance_soak(
         servers=args.servers, docs=args.docs, seed=args.seed,
         capacity=args.capacity, crowd_boost=args.crowd_boost,
@@ -1235,6 +1276,12 @@ def main(argv=None) -> int:
                    action=argparse.BooleanOptionalAction, default=True,
                    help="aim one migration at an unreachable target "
                    "and require a clean rollback")
+    c.add_argument("--split-hot-doc", action="store_true",
+                   help="writer-group arm: promote the hot doc to a "
+                   "2-writer group (>= 2x write admission), then "
+                   "member-crash and asymmetric-partition demotions "
+                   "must drain back to one writer with zero "
+                   "acked-loss / split-brain")
     c.add_argument("--progress", action="store_true")
     c.add_argument("--json", action="store_true")
     c.add_argument("--metrics-out")
@@ -1349,8 +1396,8 @@ def main(argv=None) -> int:
         "safety invariants at every state")
     c.add_argument("--scenario",
                    help="explore one scenario by name — handoff, "
-                   "crash-recovery, renewal, tiebreak, migration "
-                   "(default: all)")
+                   "crash-recovery, renewal, tiebreak, migration, "
+                   "writer-group (default: all)")
     c.add_argument("--depth", type=int, default=None,
                    help="interleaving depth bound (default 4; under "
                    "--mutate each mutation's own catch depth)")

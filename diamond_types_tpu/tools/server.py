@@ -1091,9 +1091,13 @@ class SyncHandler(BaseHTTPRequestHandler):
             # with a split health view would otherwise bounce it
             # forever) and an unreachable owner degrades to a local
             # accept — the edit is durable here, the merge gate keeps
-            # device work off this host, anti-entropy reconciles.
+            # device work off this host, anti-entropy reconciles. A
+            # writer-group member in good standing (group_accepts)
+            # accepts locally too — splitting the hot doc's write path
+            # across the group is the feature's whole point.
             target = node.route_mutation(doc_id)
             if target != node.self_id \
+                    and not node.group_accepts(doc_id) \
                     and self.headers.get("X-DT-Replication") is None:
                 # X-DT-Replication = host-targeted anti-entropy patch:
                 # the sender chose THIS host deliberately (usually it

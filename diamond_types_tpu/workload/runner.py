@@ -110,6 +110,17 @@ def _build_events(sc: Scenario) -> List[tuple]:
         while t < sc.duration_s:
             events.append((t, "churn", None))
             t += sc.session_churn_every_s
+    if sc.chaos:
+        p = sc.chaos.get("partition")
+        if p:
+            events.append((float(p["at_s"]), "cut", None))
+            events.append((float(p["heal_s"]), "heal", None))
+        c = sc.chaos.get("crash")
+        if c:
+            events.append((float(c["at_s"]), "crash",
+                           int(c.get("server", 1))))
+            events.append((float(c["restart_s"]), "reboot",
+                           int(c.get("server", 1))))
     events.sort(key=lambda e: (e[0], e[1]))
     return events
 
@@ -133,11 +144,43 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
     read_latency = Histogram()
     t_start = time.monotonic()
 
+    # ---- chaos arming (replicate/faults.py) ------------------------------
+    # a chaos tape needs two things the plain runner skips: a shared
+    # FaultInjector on every PeerTable, and per-server persistence so
+    # the crash victim reboots on its own journals and .dt files
+    faults = None
+    chaos_root = None
+    dirs: List[Optional[str]] = [None] * sc.servers
+    chaos_counts = {"partitions": 0, "heals": 0, "crashes": 0,
+                    "reboots": 0}
+    if sc.chaos:
+        import os
+        import tempfile
+
+        from ..replicate.faults import FaultInjector
+        faults = FaultInjector(seed=sc.seed)
+        chaos_root = tempfile.mkdtemp(prefix="dt-scenario-chaos-")
+        dirs = [os.path.join(chaos_root, f"n{i}")
+                for i in range(sc.servers)]
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+
+    def _node_opts(i: int) -> Dict:
+        opts = dict(seed=sc.seed, lease_ttl_s=1.0, timeout_s=2.0,
+                    backoff_base_s=0.02, backoff_cap_s=0.1)
+        if faults is not None:
+            opts["faults"] = faults
+        if dirs[i] is not None:
+            import os
+            opts["journal_prefix"] = os.path.join(dirs[i], "_replica")
+        return opts
+
     # ---- boot the mesh (replicate-soak pattern, stepped inline) ----------
     httpds, nodes, addrs = [], [], []
+    live = [True] * sc.servers
     for i in range(sc.servers):
         httpd = serve(port=0, serve_shards=sc.serve_shards,
-                      data_dir=None, follower_reads=True,
+                      data_dir=dirs[i], follower_reads=True,
                       obs_opts=dict(sample_rate=1.0), qos=qos)
         httpds.append(httpd)
         addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
@@ -145,18 +188,49 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         if sc.servers > 1:
             node = attach_replication(
                 httpd, addrs[i], [a for a in addrs if a != addrs[i]],
-                seed=sc.seed, lease_ttl_s=1.0, timeout_s=2.0,
-                backoff_base_s=0.02, backoff_cap_s=0.1)
+                **_node_opts(i))
             nodes.append(node)
         threading.Thread(target=httpd.serve_forever,
                          daemon=True).start()
 
+    def crash_server(i: int) -> None:
+        """Tear slot `i` down WITHOUT closing its journal (the reboot
+        replays the WAL, torn tail and all) — the soak's crash shape."""
+        nodes[i].journal = None
+        nodes[i].leases.journal = None
+        httpds[i].shutdown()
+        httpds[i].server_close()
+        live[i] = False
+
+    def reboot_server(i: int) -> None:
+        port = int(addrs[i].split(":")[1])
+        httpd = serve(port=port, serve_shards=sc.serve_shards,
+                      data_dir=dirs[i], follower_reads=True,
+                      obs_opts=dict(sample_rate=1.0), qos=qos)
+        node = attach_replication(
+            httpd, addrs[i], [a for a in addrs if a != addrs[i]],
+            **_node_opts(i))
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        httpds[i] = httpd
+        nodes[i] = node
+        live[i] = True
+
+    def pick_server() -> int:
+        """Round-robin target among LIVE servers (the load balancer's
+        health check; a crashed server takes no client traffic)."""
+        alive = [i for i in range(sc.servers) if live[i]]
+        return alive[rng.randrange(len(alive))]
+
     def step_control_plane() -> None:
-        for node in nodes:
+        for j, node in enumerate(nodes):
+            if not live[j]:
+                continue
             node.table.probe_once()
             node.maintain()
-        for node in nodes:
-            node.antientropy.run_round()
+        for j, node in enumerate(nodes):
+            if live[j]:
+                node.antientropy.run_round()
 
     # ---- HTTP primitives -------------------------------------------------
     def post_edit(si: int, doc: str, session: _Session,
@@ -253,22 +327,41 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                 ses = sessions[tenant][
                     rng.randrange(sc.sessions_per_tenant)]
                 tok = f"{rng.choice(_WRITE_TOKENS)} "
-                if post_edit(rng.randrange(sc.servers), doc, ses,
+                if post_edit(pick_server(), doc, ses,
                              [{"kind": "ins", "pos": 0, "text": tok}]):
                     counts.writes += 1
                     counts.write_ops += 1
             elif kind == "read":
-                get_doc(rng.randrange(sc.servers), doc_ids[arg])
+                get_doc(pick_server(), doc_ids[arg])
             elif kind == "bulk":
                 tenant = arg
                 doc = f"t{tenant}-bulk000"
                 ses = sessions[tenant][0]
                 payload = "x" * int(sc.bulk.get("bytes_per_op", 1024))
-                if post_edit(rng.randrange(sc.servers), doc, ses,
+                if post_edit(pick_server(), doc, ses,
                              [{"kind": "ins", "pos": 0,
                                "text": payload}],
                              qos_cls="bulk" if qos else None):
                     counts.bulk_ops += 1
+            elif kind == "cut":
+                p = sc.chaos["partition"]
+                faults.partition(addrs[int(p.get("a", 1))],
+                                 addrs[int(p.get("b", 0))],
+                                 oneway=bool(p.get("oneway", True)))
+                chaos_counts["partitions"] += 1
+            elif kind == "heal":
+                p = sc.chaos["partition"]
+                faults.heal(addrs[int(p.get("a", 1))],
+                            addrs[int(p.get("b", 0))])
+                chaos_counts["heals"] += 1
+            elif kind == "crash":
+                if live[arg]:
+                    crash_server(arg)
+                    chaos_counts["crashes"] += 1
+            elif kind == "reboot":
+                if not live[arg]:
+                    reboot_server(arg)
+                    chaos_counts["reboots"] += 1
             elif kind == "churn":
                 gen += 1
                 session_churns += 1
@@ -363,7 +456,13 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
     if qos_block is not None:
         qos_block["sheds_observed"] = counts.sheds
     wall_s = time.monotonic() - t_start
-    ok = bool(converged and slo_ok and counts.errors == 0)
+    # under an injected-fault tape, availability degrades by DESIGN
+    # (client errors while partitioned, SLO burn during the crash) —
+    # the run's gate is the safety property: byte-identical
+    # convergence once healed and rebooted. Errors and burn are still
+    # recorded honestly in the scorecard.
+    ok = bool(converged) if sc.chaos else \
+        bool(converged and slo_ok and counts.errors == 0)
 
     card = build_scorecard(
         scenario=sc.to_dict(),
@@ -388,12 +487,18 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         ok=ok,
         qos=qos_block,
         extra={"session_churns": session_churns,
-               **({"bank": bank_report} if bank_report else {})},
+               **({"bank": bank_report} if bank_report else {}),
+               **({"chaos": {**chaos_counts,
+                             "faults": faults.snapshot()}}
+                  if sc.chaos else {})},
     )
     publish("done", ticks, extra=f" ok={ok}")
     for httpd in httpds:
         httpd.shutdown()
         httpd.server_close()
+    if chaos_root is not None:
+        import shutil
+        shutil.rmtree(chaos_root, ignore_errors=True)
     return card
 
 

@@ -18,6 +18,10 @@ round-trippable, stamped verbatim into the scorecard) describing:
                     undersized warm tier (TieredStore + Hydrator) with
                     device-tier spill accounting — the tiered-
                     residency scale run rides this
+  chaos        optional fault tape (replicate/faults.py): one
+                    asymmetric partition plus one crash-restart at
+                    fixed virtual times; arms persistent journals so
+                    the crashed server reboots on its own state
 
 Virtual time: `duration_s` of traffic is scheduled up front on the
 scenario's injectable clock and executed in `tick_s` steps; nothing
@@ -50,6 +54,7 @@ class Scenario:
     session_churn_every_s: float = 0.0   # 0 = sessions never churn
     bulk: Optional[Dict] = None
     bank: Optional[Dict] = None
+    chaos: Optional[Dict] = None
     reconcile_rounds: int = 12
     slow: bool = False               # excluded from tier-1 by marker
 
@@ -151,6 +156,30 @@ register(Scenario(
     bulk={"arrivals": {"kind": "ramp", "start_per_s": 0.0,
                        "end_per_s": 30.0, "ramp_s": 10.0},
           "bytes_per_op": 2048},
+    slow=True,
+))
+
+# The churn tape under injected faults: one asymmetric mid-run
+# partition (server 1 cannot reach server 0, the reverse path stays
+# up) and a crash-restart of server 2 on persistent journals.
+# Client-visible errors and SLO burn are EXPECTED while the mesh
+# degrades — the gate is the safety property: every server
+# byte-identical after the heal and reboot.
+register(Scenario(
+    name="chaos-churn",
+    description="churn traffic under faults: one asymmetric partition "
+                "+ one crash-restart; availability degrades honestly, "
+                "the gate is post-heal byte-identical convergence",
+    seed=17, servers=3, serve_shards=1, tenants=2, docs_per_tenant=8,
+    duration_s=8.0, tick_s=0.25,
+    arrivals={"kind": "poisson", "rate_per_s": 10.0},
+    popularity={"kind": "zipf", "s": 1.3},
+    reads_per_write=6.0,
+    sessions_per_tenant=3, session_churn_every_s=1.5,
+    chaos={"partition": {"a": 1, "b": 0, "at_s": 2.0, "heal_s": 4.0,
+                         "oneway": True},
+           "crash": {"server": 2, "at_s": 4.5, "restart_s": 6.0}},
+    reconcile_rounds=24,
     slow=True,
 ))
 

@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: writer-group table lock-order violation.
+
+Acquires the lease lock (repl.leases, 2) while already holding the
+writer-group table lock (repl.writergroup, 6) — backwards against the
+canonical order: the table lock is a late rung, taken under the lease
+lock by the floor-raise fence hook; taking them the other way around
+deadlocks against that hook.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureWriterGroups:
+    def backwards(self, doc_id):
+        with self.writergroups.lock:
+            with self.leases.lock:
+                return self._grants.get(doc_id)

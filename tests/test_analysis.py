@@ -55,6 +55,7 @@ def _witness_clean():
     ("bad_hydration_lock_order.py", "lock-order", 14, "error"),
     ("bad_read_lock_order.py", "lock-order", 15, "error"),
     ("bad_rebalance_lock_order.py", "lock-order", 14, "error"),
+    ("bad_writergroup_lock_order.py", "lock-order", 15, "error"),
     ("bad_qos_lock_order.py", "lock-order", 17, "error"),
     ("bad_ts_lock_order.py", "lock-order", 15, "error"),
     ("bad_wire_lock_order.py", "lock-order", 14, "error"),

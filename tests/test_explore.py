@@ -15,7 +15,7 @@ lease/quorum/fencing tree:
     sleep-set/dedup machinery demonstrably prunes, and the
     max-states valve reports truncation honestly;
   * the `dt-explore` CLI gate: exit 0 on the clean tree, `--mutate`
-    exits 0 only when 5/5 mutations are detected;
+    exits 0 only when 7/7 mutations are detected;
   * the verdict reaches obs: snapshot()['explore'] + dt_explore_*
     prom families.
 """
@@ -37,7 +37,8 @@ pytestmark = pytest.mark.analysis
 # True) yet finishes in a few seconds on one CPU; handoff has the
 # widest action set so it gets the shallowest bound
 SMOKE_DEPTHS = {"handoff": 3, "crash-recovery": 4,
-                "renewal": 5, "tiebreak": 4, "migration": 3}
+                "renewal": 5, "tiebreak": 4, "migration": 3,
+                "writer-group": 3}
 
 
 # ---- the real tree is clean ----------------------------------------------

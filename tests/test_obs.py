@@ -284,7 +284,7 @@ def test_replication_metrics_v3_derived_keys():
     for s in (0.1, 0.3):
         rm.observe_handoff_latency(s)
     snap = rm.snapshot()
-    assert snap["version"] == 7
+    assert snap["version"] == 8
     assert snap["latencies"]["handoff"]["count"] == 2
     assert snap["handoffs"]["latency_s_total"] == pytest.approx(0.4)
     assert snap["handoffs"]["latency_s_max"] == pytest.approx(0.3)

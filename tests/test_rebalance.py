@@ -221,7 +221,8 @@ class _Node:
 def test_tick_is_a_noop_when_healthy_or_disabled():
     n = _Node()
     rb = Rebalancer(n, obs=_Obs("ok"))
-    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": []}
+    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": [],
+                         "promoted": [], "demoted": []}
     assert n.handoffs == []
     # stressed but disabled / rejoining: still a no-op
     rb2 = Rebalancer(n, obs=_Obs("burning"), enabled=False)
@@ -237,7 +238,8 @@ def test_act_on_narrows_the_trigger_states():
     # not stress, burning still is
     n = _Node(peer_load={"hostB": 0, "hostC": 1})
     rb = Rebalancer(n, obs=_Obs("warning"), act_on=("burning",))
-    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": []}
+    assert rb.tick() == {"stressed": [], "migrated": [], "aborted": [],
+                         "promoted": [], "demoted": []}
     rb2 = Rebalancer(n, obs=_Obs("burning"), act_on=("burning",))
     assert rb2.tick()["migrated"] == [["d1", "hostB"]]
 

@@ -439,7 +439,7 @@ def test_two_server_smoke(tmp_path):
                 assert mergers == [holder]
         for a in addrs:
             m = _metrics(a)
-            assert m["replication"]["version"] == 7
+            assert m["replication"]["version"] == 8
             assert m["replication"]["leases"]["held"] >= 0
             assert m["replication"]["antientropy"]["rounds"] >= 1
             assert "promise_conflicts" in m["replication"]["quorum"]
