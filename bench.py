@@ -1747,6 +1747,81 @@ def _main() -> None:
     except Exception as e:  # pragma: no cover
         extra["qos_ab_error"] = str(e)[:120]
 
+    # Incident-engine A/B (obs/incident.py): the same smoke scenario
+    # with the anomaly detector disabled (`--no-incidents`). The
+    # detector's contract is <=3% of scenario throughput. The smoke
+    # tape is short enough that single-run ops/s jitters +-10% on a
+    # loaded box — far above the signal — so each arm takes the best
+    # of 3 runs, and the deterministic per-poll cost (one poll() over
+    # the run's warmed series, as a fraction of the tick budget) is
+    # the primary `incidents_overhead_ok` guard; a healthy smoke tape
+    # must still open zero bundles on the armed arm.
+    try:
+        from diamond_types_tpu.workload import (get_scenario,
+                                                run_scenario)
+        runs_armed = [full.get("scenario_smoke")
+                      or run_scenario(get_scenario("smoke"))]
+        runs_armed += [run_scenario(get_scenario("smoke"))
+                       for _ in range(2)]
+        runs_dark = [run_scenario(get_scenario("smoke"), incidents=False)
+                     for _ in range(3)]
+        armed = max(runs_armed,
+                    key=lambda r: r["throughput"]["ops_per_s"])
+        base = max(r["throughput"]["ops_per_s"] for r in runs_dark)
+        overhead = round(
+            1.0 - armed["throughput"]["ops_per_s"] / max(base, 1e-9), 4)
+        # deterministic arm: time poll() itself against the smoke tick
+        import time as _time
+        from diamond_types_tpu.obs import Observability as _Obs
+        from diamond_types_tpu.obs.incident import (AnomalyDetector
+                                                    as _Det)
+        _obs = _Obs()
+        for _i in range(40):            # runner-scale warmed series
+            for _j in range(600):
+                _obs.ts.observe("inc.bench.%d" % _i, 0.01)
+        _det = _Det(_obs.ts, recorder=_obs.recorder)
+        _det.poll()
+        _t0 = _time.perf_counter()
+        for _ in range(50):
+            _det.poll()
+        _poll_s = (_time.perf_counter() - _t0) / 50
+        _tick_s = get_scenario("smoke").tick_s
+        poll_frac = round(_poll_s / _tick_s, 4)
+        extra["incidents_ab"] = {
+            "ops_per_sec": armed["throughput"]["ops_per_s"],
+            "no_incidents_ops_per_sec": base,
+            "incidents_overhead": overhead,
+            "poll_cost_s": round(_poll_s, 6),
+            "poll_tick_fraction": poll_frac,
+            "incidents_overhead_ok": poll_frac <= 0.03,
+            "bundles_opened": (armed.get("incidents") or {}).get("count"),
+        }
+    except Exception as e:  # pragma: no cover
+        extra["incidents_ab_error"] = str(e)[:120]
+
+    # Soak-resume smoke (workload/ long-run mode): checkpoint the
+    # smoke tape every virtual second, kill it at tick 3, resume from
+    # the checkpoint dir, and require the resumed run to converge with
+    # its incidents block intact — the `cli scenario run --resume`
+    # contract exercised end to end.
+    try:
+        import shutil as _sh
+        from diamond_types_tpu.workload import (get_scenario,
+                                                run_scenario)
+        part = run_scenario(get_scenario("smoke"),
+                            checkpoint_every_s=1.0, stop_after_ticks=3)
+        resumed = run_scenario(None, resume_dir=part["resume_dir"])
+        _sh.rmtree(part["resume_dir"], ignore_errors=True)
+        extra["soak_resume"] = {
+            "aborted_at_tick": part.get("tick"),
+            "ok": resumed["ok"],
+            "converged": resumed["convergence"]["converged"],
+            "resumed": resumed.get("extra", {}).get("resumed"),
+            "incidents": (resumed.get("incidents") or {}).get("count"),
+        }
+    except Exception as e:  # pragma: no cover
+        extra["soak_resume_error"] = str(e)[:120]
+
     # Peak-memory probe (reference: examples/posstats.rs behind the
     # memusage feature / trace-alloc counting allocator). Python-side
     # allocations only; the C++ tier's tables are outside tracemalloc.

@@ -157,6 +157,12 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     # structures never call back out while held
     if "_ts_lock" in src or "_sketch_lock" in src:
         return "leaf"
+    # incident engine: the AnomalyDetector state guard and the
+    # IncidentStore ring guard are leaf rungs — poll() gathers all its
+    # TimeSeries/recorder reads BEFORE taking the lock and opens
+    # bundles AFTER releasing it, so nothing ever nests under them
+    if "_incident_lock" in src:
+        return "leaf"
     if src in ("self.lock", "self._lock", "lock"):
         if "Scheduler" in class_name:
             return "global"

@@ -34,6 +34,12 @@ copied, so the rule can never drift from the schema itself):
                                dt_qos_*{class} prom families zero-fill
                                from those same tuples)
   .bump_ctl("key")             key in qos.metrics.QOS_CTL_KEYS
+  .open_incident("kind", ...)  kind in obs.incident.INCIDENT_KINDS
+                               (also `_open_locked` — the detector's
+                               internal entrypoint; the dt_incident_*
+                               prom families zero-fill from that same
+                               tuple, so an undeclared kind would mint
+                               a bundle no renderer ever counts)
 
 plus the exemplar join: a module defining `_EXEMPLAR_FAMILIES` (the
 prom histogram -> TimeSeries mapping) must only name families some
@@ -53,6 +59,7 @@ import ast
 from typing import List, Optional
 
 from ..lint import FileContext, Violation
+from ...obs.incident import INCIDENT_KINDS
 from ...qos.classes import QOS_CLASSES
 from ...qos.metrics import QOS_CLASS_KEYS, QOS_CTL_KEYS
 from ...read.metrics import READ_KEYS
@@ -166,6 +173,16 @@ def check_metrics_schema(ctx: FileContext, summary) -> List[Violation]:
                             f"qos controller decision {a0!r} is not "
                             f"in qos.metrics.QOS_CTL_KEYS "
                             f"{QOS_CTL_KEYS}")
+            elif name in ("open_incident", "_open_locked") and args:
+                a0 = _const_str(args[0])
+                if a0 is not None and a0 not in INCIDENT_KINDS:
+                    violate(node.lineno,
+                            f"incident kind {a0!r} is not in "
+                            f"obs.incident.INCIDENT_KINDS "
+                            f"{INCIDENT_KINDS} — the dt_incident_* "
+                            f"prom families zero-fill only the "
+                            f"declared kinds (open_incident would "
+                            f"also raise at runtime)")
             elif name == "record_hydration" and args:
                 a0 = _const_str(args[0])
                 if a0 is not None and a0 not in HYDRATION_KEYS:

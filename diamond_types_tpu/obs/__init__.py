@@ -31,6 +31,7 @@ from .attrib import HotAttribution, SpaceSaving
 from .devprof import PROFILER, DeviceProfiler, note_jit_lookup, note_transfer
 from .exemplars import ExemplarStore
 from .hist import BOUNDS, Histogram, HistogramSet
+from .incident import INCIDENT_KINDS, AnomalyDetector, IncidentStore
 from .journey import STAGES as JOURNEY_STAGES
 from .journey import OpJourney
 from .prom import CONTENT_TYPE, OPENMETRICS_CONTENT_TYPE, render_metrics
@@ -53,6 +54,7 @@ __all__ = [
     "TimeSeries", "SloEngine", "Objective", "default_objectives",
     "ExemplarStore", "HotAttribution", "SpaceSaving",
     "OpJourney", "JOURNEY_STAGES",
+    "AnomalyDetector", "IncidentStore", "INCIDENT_KINDS",
     "SCORECARD_VERSION", "build_scorecard", "diff_scorecards",
     "publish_scenario", "last_scenario",
 ]
@@ -75,7 +77,10 @@ class Observability:
                  ts_window_s: float = 10.0, ts_windows: int = 360,
                  objectives=None, attrib_k: int = 64,
                  journey: bool = True,
-                 journey_capacity: int = 512) -> None:
+                 journey_capacity: int = 512,
+                 incidents: bool = True,
+                 incident_dir=None,
+                 incident_opts=None) -> None:
         self.tracer = Tracer(sample_rate=sample_rate,
                              capacity=trace_capacity,
                              seed=seed, enabled=enabled)
@@ -99,8 +104,27 @@ class Observability:
         self.journey = OpJourney(capacity=journey_capacity,
                                  ts=self.ts if live else None,
                                  enabled=enabled and journey)
+        # incident engine: pull-driven anomaly detection over the live
+        # tier + evidence-bundle capture. `incidents=False` is the
+        # bench A/B control arm (poll() is a single-branch no-op); the
+        # store stays constructed so /debug/incidents answers (empty)
+        # and the prom families zero-fill either way.
+        opts = dict(incident_opts or {})
+        store_opts = {k: opts.pop(k) for k in ("capacity", "prefix")
+                      if k in opts}
+        self.incidents = IncidentStore(data_dir=incident_dir,
+                                       **store_opts)
+        self.incidents.attach(self)
+        self.incident_detector = AnomalyDetector(
+            self.ts, recorder=self.recorder, store=self.incidents,
+            enabled=live and incidents, **opts)
 
     def snapshot(self) -> dict:
+        # pull-driven detection (the SloEngine idiom): every snapshot
+        # (== every /metrics scrape) re-evaluates the watched series
+        self.incident_detector.poll()
+        det = self.incident_detector.snapshot()
+        sto = self.incidents.snapshot()
         out = {"trace": self.tracer.stats(),
                "recorder": self.recorder.stats(),
                "http": self.hist.snapshot(),
@@ -109,7 +133,8 @@ class Observability:
                "slo": self.slo.snapshot(),
                "exemplars": self.exemplars.snapshot(),
                "hot": self.attrib.snapshot(),
-               "journey": self.journey.snapshot()}
+               "journey": self.journey.snapshot(),
+               "incidents": {"version": 1, **sto, **det}}
         # concurrency-invariant tier (analysis/): the runtime lock
         # witness is always reported (enabled=False when off); the
         # lint block appears once a dt-lint run published a report in

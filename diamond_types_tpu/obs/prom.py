@@ -41,6 +41,10 @@ Naming scheme:
                                       (zero-filled over journey.STAGES)
   dt_convergence_lag_*{peer}          per-peer admitted->advert lag
                                       rollup (+ the peer="all" row)
+  dt_incident_opened_total{kind}      incident engine: bundles opened
+                                      (zero-filled over INCIDENT_KINDS)
+  dt_incident_suppressed_total        cooldown-deduped detections
+  dt_incident_open                    unacknowledged-bundle gauge
 
 Each metric name is declared exactly once (# TYPE line) no matter how
 many labeled samples it carries; label values are escaped per the
@@ -63,6 +67,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .incident import INCIDENT_KINDS
 from .journey import STAGES as JOURNEY_STAGES
 
 CONTENT_TYPE = "text/plain; version=0.0.4"
@@ -496,6 +501,22 @@ def _render_obs(b: _Builder, obs: dict) -> None:
                   labels=lb)
             b.add("dt_convergence_lag_seconds_max", "gauge",
                   row.get("max_s", 0.0), labels=lb)
+    # incident engine: zero-filled over INCIDENT_KINDS (the journey-
+    # stage idiom) so every kind row exists from the first scrape even
+    # on an idle server; the block itself is always present in the obs
+    # snapshot, detector enabled or not.
+    inc = obs.get("incidents")
+    if isinstance(inc, dict):
+        b.add("dt_incident_detector_enabled", "gauge",
+              1 if inc.get("enabled") else 0)
+        kinds = dict.fromkeys(INCIDENT_KINDS, 0)
+        kinds.update(inc.get("by_kind") or {})
+        for kind in INCIDENT_KINDS:
+            b.add("dt_incident_opened_total", "counter", kinds[kind],
+                  labels={"kind": kind})
+        b.add("dt_incident_suppressed_total", "counter",
+              inc.get("suppressed", 0))
+        b.add("dt_incident_open", "gauge", inc.get("open", 0))
     hot = obs.get("hot") or {}
     for dim in ("doc", "agent"):
         for kind, block in sorted((hot.get(dim) or {}).items()):

@@ -59,6 +59,7 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
                     per_server: Optional[List[dict]] = None,
                     ok: bool = True,
                     qos: Optional[dict] = None,
+                    incidents: Optional[dict] = None,
                     extra: Optional[dict] = None) -> dict:
     """Assemble the stable scorecard document. Derived ratios
     (throughput, bytes/op) are computed here so every producer agrees
@@ -113,6 +114,11 @@ def build_scorecard(*, scenario: dict, wall_s: float, virtual_s: float,
         # on static-admission runs so pre-QoS baselines diff clean; no
         # band gates on it — shed counts are policy, not regressions.
         card["qos"] = dict(qos)
+    if incidents is not None:
+        # incident engine rollup (count by kind, worst burn-minutes
+        # bundle id, timeline). Absent from pre-incident baselines so
+        # they diff clean; `incidents.count` is band-gated.
+        card["incidents"] = dict(incidents)
     if latencies is not None:
         card["latencies"] = latencies
     if per_server is not None:
@@ -167,6 +173,10 @@ DEFAULT_BANDS: Dict[str, Band] = {
     "wire.proxy.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
     "wire.hydrate.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
     "wire.gossip.bytes_per_op": Band("lower", rel=0.30, abs_=16.0),
+    # incident engine: more auto-captured incidents than the baseline
+    # is a health regression even when the boolean gates still pass.
+    # Generous absolute slack — a chaos tape legitimately opens a few.
+    "incidents.count": Band("lower", rel=0.5, abs_=4.0),
 }
 
 # Boolean invariants: must never flip good -> bad.

@@ -822,6 +822,10 @@ def cmd_obs_watch(args) -> int:
         except (OSError, ValueError) as e:
             print(f"obs-watch: scrape failed: {e}", file=sys.stderr)
             return 1
+        try:
+            incidents = _get("/debug/incidents")
+        except (OSError, ValueError):
+            incidents = None    # pre-incident server: panel omitted
         tail = events.get("events") or []
         if tail:
             since = max(ev.get("seq", since) for ev in tail)
@@ -836,6 +840,7 @@ def cmd_obs_watch(args) -> int:
                               "devprof": (doc.get("obs") or {})
                               .get("devprof"),
                               "qos": doc.get("qos"),
+                              "incidents": incidents,
                               "scenario": (doc.get("obs") or {})
                               .get("scenario")}))
         else:
@@ -894,6 +899,25 @@ def cmd_obs_watch(args) -> int:
                     f"{k}={ctl.get(k, 0)}"
                     for k in ("steps", "stretched", "shrunk", "held",
                               "floors", "ceilings")))
+            if incidents is not None:
+                # incident panel: open bundles by kind + the newest
+                # bundle id (fetch the full bundle with dt-incidents)
+                by_kind = incidents.get("by_kind") or {}
+                kinds = " ".join(f"{k}={v}"
+                                 for k, v in sorted(by_kind.items())
+                                 if v)
+                print(f"== incidents (open={incidents.get('open', 0)} "
+                      f"total={incidents.get('total', 0)}"
+                      + (f" last={incidents.get('last_id')}"
+                         if incidents.get("last_id") else "")
+                      + ") ==")
+                if kinds:
+                    print(f"  {kinds}")
+                for row in (incidents.get("incidents") or [])[:5]:
+                    mark = " " if row.get("acknowledged") else "!"
+                    print(f"  [{mark}] {row.get('id', '?'):<16s} "
+                          f"{row.get('kind', '?'):<12s} "
+                          f"{row.get('series', '?')}")
             print("== hot docs ==")
             for kind, block in sorted((hot.get("doc") or {}).items()):
                 tops = (block.get("top") or [])[:args.top]
@@ -943,6 +967,8 @@ def cmd_obs_watch(args) -> int:
                                  for k, v in sorted(rest.items())))
         if not slo.get("ok", True):
             rc = 1
+        if incidents is not None and incidents.get("open", 0) > 0:
+            rc = 1    # an unacknowledged incident is an alert
         rounds_done += 1
         if args.rounds and rounds_done >= args.rounds:
             return rc
@@ -1033,6 +1059,144 @@ def cmd_dt_trace(args) -> int:
     return rc
 
 
+def cmd_dt_incidents(args) -> int:
+    """Incident-bundle browser with dt-trace's peer fan-out. With no
+    ids: list every host's incident index (`--tail` instead follows
+    the indexes and prints bundles as they open). With ids: fetch each
+    bundle from whichever host holds it (GET /debug/incidents/<id>)
+    and print the evidence — recorder tail, SLO burn rates, hot docs,
+    convergence lag, trace ids. rc=1 when a requested id resolves on
+    no host."""
+    import urllib.error
+    import urllib.request
+    hosts = [args.url] + [h for h in
+                          (args.peers.split(",") if args.peers else [])
+                          if h.strip()]
+    bases = []
+    for h in hosts:
+        h = h.strip().rstrip("/")
+        if "://" not in h:
+            h = "http://" + h
+        if h not in bases:
+            bases.append(h)
+
+    def _get(base, path):
+        with urllib.request.urlopen(base + path,
+                                    timeout=args.timeout) as r:
+            return json.loads(r.read())
+
+    def _indexes():
+        out = []
+        for base in bases:
+            try:
+                out.append((base, _get(base, "/debug/incidents")))
+            except (OSError, ValueError) as e:
+                # a down peer degrades the listing, never kills it
+                print(f"dt-incidents: {base} fetch failed: {e}",
+                      file=sys.stderr)
+        return out
+
+    def _print_index(base, idx):
+        print(f"== incidents on {idx.get('host', base)} "
+              f"(open={idx.get('open', 0)} "
+              f"total={idx.get('total', 0)}) ==")
+        for row in idx.get("incidents") or []:
+            mark = " " if row.get("acknowledged") else "!"
+            print(f"  [{mark}] {row.get('id', '?'):<16s} "
+                  f"{row.get('kind', '?'):<12s} "
+                  f"{row.get('series', '?'):<32s} "
+                  f"t={row.get('t', 0):.1f}")
+
+    if args.tail:
+        # follow mode: poll every index and print bundles newly opened
+        # since the previous round (per-host seen-id cursor)
+        seen = {}
+        rounds_done = 0
+        while True:
+            for base, idx in _indexes():
+                known = seen.setdefault(base, set())
+                for row in reversed(idx.get("incidents") or []):
+                    if row["id"] in known:
+                        continue
+                    known.add(row["id"])
+                    if args.json:
+                        print(json.dumps({"host": idx.get("host", base),
+                                          **row}))
+                    else:
+                        print(f"{idx.get('host', base)}  "
+                              f"{row.get('id', '?'):<16s} "
+                              f"{row.get('kind', '?'):<12s} "
+                              f"{row.get('series', '?')}")
+            rounds_done += 1
+            if args.rounds and rounds_done >= args.rounds:
+                return 0
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+
+    if not args.incident_ids:
+        idxs = _indexes()
+        if args.json:
+            print(json.dumps({"hosts": [dict(idx, base=base)
+                                        for base, idx in idxs]}))
+        else:
+            for base, idx in idxs:
+                _print_index(base, idx)
+        return 0 if idxs else 1
+
+    rc = 0
+    for iid in args.incident_ids:
+        bundle, src = None, None
+        for base in bases:
+            try:
+                bundle = _get(base, f"/debug/incidents/{iid}")
+                src = base
+                break
+            except urllib.error.HTTPError as e:
+                e.close()    # 404 here just means "not this host"
+            except (OSError, ValueError) as e:
+                print(f"dt-incidents: {base} fetch failed: {e}",
+                      file=sys.stderr)
+        if bundle is None:
+            print(f"dt-incidents: {iid} not found on any host",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            print(json.dumps({"host": src, **bundle}))
+            continue
+        print(f"== {bundle.get('id')} {bundle.get('kind')} "
+              f"series={bundle.get('series')} (from {src}) ==")
+        print("  detail: " + json.dumps(bundle.get("detail") or {}))
+        ctx = bundle.get("context")
+        if ctx:
+            print("  context: " + json.dumps(ctx))
+        for row in bundle.get("slo") or []:
+            print(f"  slo {row.get('name', '?'):<24s} "
+                  f"{row.get('state', '?'):<8s} "
+                  f"fast={row.get('fast_burn', 0):.2f} "
+                  f"slow={row.get('slow_burn', 0):.2f}")
+        lag = bundle.get("convergence_lag") or {}
+        for peer, row in sorted(lag.items()):
+            print(f"  lag {peer:<22s} n={row.get('n', 0)} "
+                  f"max={row.get('max_s', 0) * 1e3:.1f}ms")
+        traces = [t for t in bundle.get("traces") or [] if t]
+        if traces:
+            print("  traces: " + " ".join(traces)
+                  + "   (assemble with dt-trace)")
+        tail = bundle.get("recorder_tail") or []
+        print(f"  recorder tail ({len(tail)} events):")
+        for ev in tail[-args.events:]:
+            rest = {k: v for k, v in ev.items()
+                    if k not in ("seq", "t", "kind")}
+            print(f"    [{ev.get('seq', '?'):>5}] "
+                  f"{ev.get('kind', '?'):<24s} "
+                  + " ".join(f"{k}={v}"
+                             for k, v in sorted(rest.items())))
+    return rc
+
+
 def cmd_scenario(args) -> int:
     """Declarative workload harness (workload/): `scenario list`
     prints the registry; `scenario run --name X` drives the scenario
@@ -1046,21 +1210,36 @@ def cmd_scenario(args) -> int:
             mark = " [slow]" if sc.slow else ""
             print(f"{name:<16s}{mark:>7s}  {sc.description}")
         return 0
-    if not args.name:
-        print("scenario run: --name is required (see `scenario list`)",
-              file=sys.stderr)
-        return 2
-    try:
-        sc = get_scenario(args.name)
-    except ValueError as e:
-        print(f"scenario: {e}", file=sys.stderr)
-        return 2
-    if args.seed is not None:
-        import dataclasses
-        sc = dataclasses.replace(sc, seed=args.seed)
-    card = run_scenario(sc, data_dir=args.data_dir,
-                        progress=args.progress, qos=args.qos)
+    if args.resume:
+        # the scenario (and its qos/incident toggles) ride inside the
+        # checkpoint; --name is neither needed nor honored
+        card = run_scenario(None, resume_dir=args.resume,
+                            data_dir=args.data_dir,
+                            progress=args.progress,
+                            stop_after_ticks=args.stop_after_ticks)
+    else:
+        if not args.name:
+            print("scenario run: --name is required "
+                  "(see `scenario list`)", file=sys.stderr)
+            return 2
+        try:
+            sc = get_scenario(args.name)
+        except ValueError as e:
+            print(f"scenario: {e}", file=sys.stderr)
+            return 2
+        if args.seed is not None:
+            import dataclasses
+            sc = dataclasses.replace(sc, seed=args.seed)
+        card = run_scenario(sc, data_dir=args.data_dir,
+                            progress=args.progress, qos=args.qos,
+                            incidents=args.incidents,
+                            checkpoint_every_s=args.checkpoint_every,
+                            stop_after_ticks=args.stop_after_ticks)
     print(json.dumps(card, indent=1 if args.json else None))
+    if card.get("aborted"):
+        # deliberate mid-run kill (--stop-after-ticks): the checkpoint
+        # under resume_dir is the product, not a failure
+        return 0
     if args.out:
         with open(args.out, "w") as f:
             f.write(json.dumps(card, indent=1) + "\n")
@@ -1489,9 +1668,58 @@ def main(argv=None) -> int:
     c.add_argument("--no-qos", dest="qos", action="store_false",
                    help="static admission — the A/B control arm for "
                    "scorecard-diff against an adaptive run")
+    c.add_argument("--incidents", dest="incidents",
+                   action="store_true", default=True,
+                   help="arm the incident engine's anomaly detector "
+                   "on every scenario server (default)")
+    c.add_argument("--no-incidents", dest="incidents",
+                   action="store_false",
+                   help="detector off — the overhead A/B control arm")
+    c.add_argument("--checkpoint-every", type=float, default=0.0,
+                   metavar="VIRT_S",
+                   help="long-run mode: persist a runner-state "
+                   "checkpoint (tape cursor, session frontiers, rng, "
+                   "incident index) every N virtual seconds under a "
+                   "kept run dir; resume with --resume")
+    c.add_argument("--resume", default=None, metavar="DIR",
+                   help="resume a checkpointed run: reboot the "
+                   "servers on their journaled dirs and replay the "
+                   "tape from the cursor (the scenario rides inside "
+                   "the checkpoint)")
+    c.add_argument("--stop-after-ticks", type=int, default=None,
+                   metavar="N",
+                   help="force-checkpoint after tick N and tear the "
+                   "mesh down crash-style (the scripted mid-run kill "
+                   "for soak drills; exit 0 with an aborted marker)")
     c.add_argument("--json", action="store_true",
                    help="pretty-print the scorecard")
     c.set_defaults(fn=cmd_scenario)
+
+    c = sub.add_parser(
+        "dt-incidents",
+        help="incident-bundle browser: list every host's auto-captured "
+        "incident index, show full evidence bundles by id, or --tail "
+        "new bundles as they open (peer fan-out like dt-trace)")
+    c.add_argument("url", help="primary server base URL")
+    c.add_argument("incident_ids", nargs="*",
+                   help="bundle ids to show (none: list the indexes)")
+    c.add_argument("--peers", default="",
+                   help="comma-separated peer base URLs to include "
+                   "in the fan-out")
+    c.add_argument("--tail", action="store_true",
+                   help="follow mode: poll the indexes and print "
+                   "bundles as they open")
+    c.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between --tail polls")
+    c.add_argument("--rounds", type=int, default=0,
+                   help="stop --tail after N polls (0 = until "
+                   "interrupted)")
+    c.add_argument("--events", type=int, default=15,
+                   help="recorder-tail events to print per bundle")
+    c.add_argument("--timeout", type=float, default=5.0)
+    c.add_argument("--json", action="store_true",
+                   help="print bundles/indexes as JSON")
+    c.set_defaults(fn=cmd_dt_incidents)
 
     c = sub.add_parser(
         "scorecard-diff",

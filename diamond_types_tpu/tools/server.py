@@ -667,8 +667,10 @@ class SyncHandler(BaseHTTPRequestHandler):
                 "snapshot") else "other")
         if head in ("replicate", "debug") and len(parts) == 2:
             return f"{head}_{parts[1]}"
-        if head == "debug" and len(parts) == 3 and parts[1] == "trace":
-            return "debug_trace"   # trace ids must not mint series
+        if head == "debug" and len(parts) == 3 \
+                and parts[1] in ("trace", "incidents"):
+            # trace/incident ids must not mint series
+            return f"debug_{parts[1]}"
         if head in ("metrics", "edit", "vis", "crdt"):
             return head
         return "other"
@@ -798,6 +800,26 @@ class SyncHandler(BaseHTTPRequestHandler):
                        "spans": obs.tracer.find(parts[2])}
                 return self._send(200, json.dumps(out).encode("utf8"),
                                   extra=no_store)
+            if obs is not None and len(parts) == 2 \
+                    and parts[1] == "incidents":
+                # incident-bundle index: counts by kind + newest-first
+                # rows (cli dt-incidents / obs-watch poll this)
+                node = self.store.replica
+                host = node.self_id if node is not None else "local"
+                out = {"host": host, **obs.incidents.index_json()}
+                return self._send(200, json.dumps(out).encode("utf8"),
+                                  extra=no_store)
+            if obs is not None and parts[1:2] == ["incidents"] \
+                    and len(parts) == 3:
+                # one full evidence bundle by id (404s after eviction —
+                # the persisted JSON under the data dir outlives the
+                # in-memory ring)
+                bundle = obs.incidents.get(parts[2])
+                if bundle is None:
+                    return self._send(404, b"{}")
+                return self._send(
+                    200, json.dumps(bundle, default=str).encode("utf8"),
+                    extra=no_store)
             if obs is not None and len(parts) == 2 \
                     and parts[1] == "traces":
                 # recent sampled trace index (newest first): the entry
@@ -1453,7 +1475,12 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
     so the default is cheap enough to leave on."""
     from ..obs import Observability
     store = DocStore(data_dir)
-    store.obs = Observability(**(obs_opts or {}))
+    oo = dict(obs_opts or {})
+    if data_dir is not None:
+        # incident bundles park next to the journals/snapshots they
+        # explain; callers may still override with their own dir
+        oo.setdefault("incident_dir", data_dir)
+    store.obs = Observability(**oo)
     if serve_shards:
         # engine="host" on purpose: this process serves HTTP, and
         # first-touch JAX backend init against a wedged accelerator
@@ -1471,6 +1498,8 @@ def serve(port: int = 8008, data_dir: Optional[str] = None,
             # (and stops) with the scheduler's own lifecycle
             from ..qos import QosController
             sched.attach_qos(QosController(**(qos_opts or {})))
+            # incident bundles freeze the controller state at capture
+            store.obs.incidents.qos_provider = sched.qos.export
         sched.start_pump()
     if follower_reads:
         # staleness-bounded local GETs on non-owner replicas + the
@@ -1593,6 +1622,11 @@ def main() -> None:
                    "(qos/): per-class effective flush deadlines, depth "
                    "budgets and mesh-aware 429 load shedding; state at "
                    "/debug/qos (requires --serve-shards)")
+    p.add_argument("--no-incidents", dest="incidents",
+                   action="store_false", default=True,
+                   help="disable the incident engine's anomaly "
+                   "detector (the overhead A/B control arm); "
+                   "/debug/incidents still answers, empty")
     args = p.parse_args()
     peers = [s.strip() for s in args.peers.split(",") if s.strip()] \
         if args.peers else ([] if args.join else None)
@@ -1600,7 +1634,8 @@ def main() -> None:
                   serve_shards=args.serve_shards, peers=peers,
                   replicate_opts={"lease_ttl_s": args.lease_ttl,
                                   "join": args.join},
-                  obs_opts={"sample_rate": args.obs_sample_rate},
+                  obs_opts={"sample_rate": args.obs_sample_rate,
+                            "incidents": args.incidents},
                   follower_reads=args.follower_reads,
                   qos=args.qos)
     print(f"serving on http://127.0.0.1:{args.port}"

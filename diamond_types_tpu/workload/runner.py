@@ -30,7 +30,10 @@ Wall time is bounded by real work: nothing sleeps to simulate load.
 from __future__ import annotations
 
 import json
+import os
 import random
+import shutil
+import tempfile
 import threading
 import time
 import urllib.error
@@ -39,10 +42,26 @@ from typing import Dict, List, Optional
 
 from ..analysis.witness import make_lock
 from ..obs.hist import Histogram
+from ..obs.incident import INCIDENT_KINDS
 from ..obs.scorecard import build_scorecard, publish_scenario
 from .arrivals import make_arrivals
 from .popularity import Zipf, make_popularity
 from .spec import Scenario
+
+# detector tuning for scenario runs: windows sized to wall seconds of
+# tick work (not virtual time); min_rate high enough that boot-burst
+# series (lease acquires, quorum rounds — steady for a few startup
+# polls, then legitimately quiet forever) never warm into the stall
+# watch; stall_after_s longer than the flash-crowd tape's 4.5 s
+# inter-burst gap so bursty-but-healthy traffic never alarms; and a
+# cooldown short enough that a partition and a crash in one tape each
+# get their own bundle. Tuned empirically: flash-crowd must produce
+# ZERO bundles, chaos-churn at least one (the p99 step the partition
+# puts on read staleness).
+RUNNER_INCIDENT_OPTS = dict(cooldown_s=30.0, rate_window_s=10.0,
+                            stall_after_s=5.0, warmup_polls=4,
+                            min_rate=1.0, spike_factor=8.0,
+                            p99_factor=6.0, min_p99_s=0.01)
 
 _WRITE_TOKENS = ("edit", "merge", "patch", "sync", "word", "line")
 
@@ -125,17 +144,54 @@ def _build_events(sc: Scenario) -> List[tuple]:
     return events
 
 
-def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
-                 progress: bool = False, qos: bool = False) -> dict:
+def run_scenario(sc: Optional[Scenario], data_dir: Optional[str] = None,
+                 progress: bool = False, qos: bool = False,
+                 incidents: bool = True,
+                 incident_opts: Optional[dict] = None,
+                 checkpoint_every_s: float = 0.0,
+                 resume_dir: Optional[str] = None,
+                 stop_after_ticks: Optional[int] = None) -> dict:
     """`qos=True` attaches the adaptive-admission controller to every
     server and tags lanes with their class (interactive edits vs bulk
     imports); the scorecard then carries a `qos` block merged across
     the mesh. Default False keeps the static admission path byte-
-    identical — the A/B control arm for `scorecard-diff`."""
+    identical — the A/B control arm for `scorecard-diff`.
+
+    `incidents=True` (default) arms the incident engine's anomaly
+    detector on every server (polled once per tick) and embeds an
+    `incidents` block in the scorecard; `incidents=False` is the
+    overhead A/B control arm.
+
+    Long-run mode: `checkpoint_every_s > 0` arms per-server persistent
+    data dirs (the chaos-churn journaling) and writes a runner-state
+    checkpoint — tape cursor, per-session frontiers, rng state,
+    interim counters, incident index — every N *virtual* seconds.
+    `resume_dir` reloads such a checkpoint (`sc` may be None; the
+    scenario rides inside it), reboots the servers on their journaled
+    dirs, and replays the tape from the cursor, so the final scorecard
+    is the one the uninterrupted run would have produced.
+    `stop_after_ticks` force-checkpoints after that tick and tears the
+    mesh down crash-style (the in-process kill used by the resume test
+    and the bench soak-resume smoke), returning an `aborted` marker
+    instead of a scorecard."""
     from ..qos.classes import QOS_HEADER
     from ..qos.metrics import merge_snapshots
     from ..replicate.node import attach_replication
     from ..tools.server import serve
+
+    # ---- resume: the scenario and all toggles ride the checkpoint --------
+    ck = None
+    run_root = None
+    if resume_dir is not None:
+        with open(os.path.join(resume_dir, "checkpoint.json"),
+                  encoding="utf8") as f:
+            ck = json.load(f)
+        sc = Scenario.from_dict(ck["scenario"])
+        qos = bool(ck["qos"])
+        incidents = bool(ck["incidents"])
+        incident_opts = ck.get("incident_opts") or incident_opts
+        checkpoint_every_s = float(ck.get("checkpoint_every_s") or 0.0)
+        run_root = resume_dir
 
     rng = random.Random(f"runner:{sc.name}:{sc.seed}")
     events = _build_events(sc)
@@ -143,24 +199,28 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
     counts = _Counts()
     read_latency = Histogram()
     t_start = time.monotonic()
+    inc_opts = {**RUNNER_INCIDENT_OPTS, **(incident_opts or {})}
 
-    # ---- chaos arming (replicate/faults.py) ------------------------------
+    # ---- persistence arming (replicate/faults.py + long-run mode) --------
     # a chaos tape needs two things the plain runner skips: a shared
     # FaultInjector on every PeerTable, and per-server persistence so
-    # the crash victim reboots on its own journals and .dt files
+    # the crash victim reboots on its own journals and .dt files. The
+    # long-run mode arms the same per-server dirs (checkpoint/resume
+    # rides the journals), chaos or not.
     faults = None
-    chaos_root = None
+    persist = bool(sc.chaos) or checkpoint_every_s > 0 \
+        or resume_dir is not None
+    keep_root = checkpoint_every_s > 0 or resume_dir is not None
     dirs: List[Optional[str]] = [None] * sc.servers
     chaos_counts = {"partitions": 0, "heals": 0, "crashes": 0,
                     "reboots": 0}
     if sc.chaos:
-        import os
-        import tempfile
-
         from ..replicate.faults import FaultInjector
         faults = FaultInjector(seed=sc.seed)
-        chaos_root = tempfile.mkdtemp(prefix="dt-scenario-chaos-")
-        dirs = [os.path.join(chaos_root, f"n{i}")
+    if persist:
+        if run_root is None:
+            run_root = tempfile.mkdtemp(prefix="dt-scenario-run-")
+        dirs = [os.path.join(run_root, f"n{i}")
                 for i in range(sc.servers)]
         for d in dirs:
             os.makedirs(d, exist_ok=True)
@@ -171,17 +231,55 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         if faults is not None:
             opts["faults"] = faults
         if dirs[i] is not None:
-            import os
             opts["journal_prefix"] = os.path.join(dirs[i], "_replica")
         return opts
 
     # ---- boot the mesh (replicate-soak pattern, stepped inline) ----------
     httpds, nodes, addrs = [], [], []
     live = [True] * sc.servers
-    for i in range(sc.servers):
-        httpd = serve(port=0, serve_shards=sc.serve_shards,
+    boots = [0] * sc.servers
+    tick_box = {"tick": 0}
+    burn_minutes: Dict[str, float] = {}
+    prior_incidents: List[dict] = []
+    prior_suppressed = 0
+
+    def _mk_context(i: int):
+        """Capture-time context frozen into each incident bundle: the
+        burn-minute integral and tick let the scorecard rank bundles
+        by worst burn."""
+        def ctx() -> dict:
+            return {"server": addrs[i] if i < len(addrs) else None,
+                    "tick": tick_box["tick"],
+                    "burn_minutes_total":
+                        round(sum(burn_minutes.values()), 4)}
+        return ctx
+
+    def _serve_node(i: int, port: int = 0):
+        boots[i] += 1
+        httpd = serve(port=port, serve_shards=sc.serve_shards,
                       data_dir=dirs[i], follower_reads=True,
-                      obs_opts=dict(sample_rate=1.0), qos=qos)
+                      obs_opts=dict(
+                          sample_rate=1.0, incidents=incidents,
+                          incident_opts=dict(
+                              inc_opts,
+                              prefix=f"n{i}.{boots[i]}.")),
+                      qos=qos)
+        httpd.store.obs.incidents.context_provider = _mk_context(i)
+        return httpd
+
+    saved_ports = (ck.get("ports") or []) if ck is not None else []
+    for i in range(sc.servers):
+        httpd = None
+        if i < len(saved_ports):
+            # resume prefers the checkpointed ports (replica journals
+            # key lease state by self_id = host:port); fall back to an
+            # ephemeral port if something else grabbed it meanwhile
+            try:
+                httpd = _serve_node(i, port=int(saved_ports[i]))
+            except OSError:
+                httpd = None
+        if httpd is None:
+            httpd = _serve_node(i)
         httpds.append(httpd)
         addrs.append(f"127.0.0.1:{httpd.server_address[1]}")
     for i, httpd in enumerate(httpds):
@@ -193,9 +291,31 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         threading.Thread(target=httpd.serve_forever,
                          daemon=True).start()
 
+    def _harvest_incidents(i: int) -> None:
+        """Fold server i's in-memory incident index (+ per-bundle burn
+        context) into the run-level rows before its obs bundle is lost
+        to a crash/teardown, exactly once per boot."""
+        nonlocal prior_suppressed
+        httpd = httpds[i]
+        if getattr(httpd, "_incidents_harvested", False):
+            return
+        httpd._incidents_harvested = True
+        obs = httpd.store.obs
+        for r in obs.incidents.index_json()["incidents"]:
+            b = obs.incidents.get(r["id"]) or {}
+            ctx = b.get("context") or {}
+            prior_incidents.append({
+                "id": r["id"], "t": r["t"], "kind": r["kind"],
+                "series": r["series"], "detail": r.get("detail"),
+                "server": addrs[i],
+                "burn_minutes_total":
+                    ctx.get("burn_minutes_total", 0.0)})
+        prior_suppressed += obs.incident_detector.suppressed
+
     def crash_server(i: int) -> None:
         """Tear slot `i` down WITHOUT closing its journal (the reboot
         replays the WAL, torn tail and all) — the soak's crash shape."""
+        _harvest_incidents(i)
         nodes[i].journal = None
         nodes[i].leases.journal = None
         httpds[i].shutdown()
@@ -204,9 +324,7 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
 
     def reboot_server(i: int) -> None:
         port = int(addrs[i].split(":")[1])
-        httpd = serve(port=port, serve_shards=sc.serve_shards,
-                      data_dir=dirs[i], follower_reads=True,
-                      obs_opts=dict(sample_rate=1.0), qos=qos)
+        httpd = _serve_node(i, port=port)
         node = attach_replication(
             httpd, addrs[i], [a for a in addrs if a != addrs[i]],
             **_node_opts(i))
@@ -289,10 +407,112 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
     # ---- tick loop -------------------------------------------------------
     ticks = max(int(sc.duration_s / sc.tick_s + 0.999999), 1)
     # zero-filled per objective so the scorecard column is explicit
-    # (and diffable) even on a fully healthy run
-    burn_minutes: Dict[str, float] = {
-        o.name: 0.0 for o in httpds[0].store.obs.slo.objectives}
+    # (and diffable) even on a fully healthy run (update in place:
+    # the incident context closures hold a reference)
+    for o in httpds[0].store.obs.slo.objectives:
+        burn_minutes[o.name] = 0.0
     ev_i = 0
+    start_tick = 0
+
+    # ---- resume: restore the runner state the checkpoint froze ----------
+    if ck is not None:
+        start_tick = int(ck["tick"])
+        ev_i = int(ck["ev_i"])
+        gen = int(ck["gen"])
+        session_churns = int(ck["session_churns"])
+        counts.__dict__.update(ck["counts"])
+        burn_minutes.update(ck["burn_minutes"])
+        chaos_counts.update(ck["chaos_counts"])
+        st = ck["rng_state"]
+        rng.setstate((st[0], tuple(st[1]), st[2]))
+        h = ck["read_latency"]
+        read_latency.counts = list(h["counts"])
+        read_latency.overflow = int(h["overflow"])
+        read_latency.count = int(h["count"])
+        read_latency.sum = float(h["sum"])
+        read_latency.max = float(h["max"])
+        sessions = {}
+        for t_key, rows in ck["sessions"].items():
+            lst = []
+            for k, row in enumerate(rows):
+                s = _Session(int(t_key), k, gen)
+                s.agent = row["agent"]
+                s.versions = {d: list(v)
+                              for d, v in row["versions"].items()}
+                lst.append(s)
+            sessions[int(t_key)] = lst
+        prior_incidents.extend(ck.get("incident_index") or [])
+        prior_suppressed += int(ck.get("suppressed") or 0)
+        # re-create the mid-crash topology the checkpoint froze (the
+        # tape's pending reboot event will bring the victim back)
+        for i, was_live in enumerate(ck.get("live") or []):
+            if not was_live and live[i] and nodes:
+                crash_server(i)
+
+    def _write_checkpoint(next_tick: int) -> None:
+        """Atomic runner-state checkpoint under the run root: enough
+        to replay the tape from `next_tick` against rebooted servers.
+        The doc/lease state itself is NOT here — it lives in the
+        per-server journals the same dirs already persist."""
+        state = {
+            "version": 1,
+            "scenario": sc.to_dict(),
+            "qos": qos, "incidents": incidents,
+            "incident_opts": incident_opts,
+            "checkpoint_every_s": checkpoint_every_s,
+            "tick": next_tick, "ticks": ticks, "ev_i": ev_i,
+            "gen": gen, "session_churns": session_churns,
+            "counts": dict(counts.__dict__),
+            "burn_minutes": dict(burn_minutes),
+            "chaos_counts": dict(chaos_counts),
+            "live": list(live),
+            "ports": [int(a.split(":")[1]) for a in addrs],
+            "rng_state": [rng.getstate()[0], list(rng.getstate()[1]),
+                          rng.getstate()[2]],
+            "read_latency": {"counts": list(read_latency.counts),
+                             "overflow": read_latency.overflow,
+                             "count": read_latency.count,
+                             "sum": read_latency.sum,
+                             "max": read_latency.max},
+            "sessions": {str(t): [{"agent": s.agent,
+                                   "versions": s.versions}
+                                  for s in lst]
+                         for t, lst in sessions.items()},
+            "incident_index": prior_incidents + [
+                r for i in range(sc.servers) if live[i]
+                for r in _peek_incidents(i)],
+            "suppressed": prior_suppressed + sum(
+                httpds[i].store.obs.incident_detector.suppressed
+                for i in range(sc.servers) if live[i]),
+            # interim scorecard: the coarse progress numbers an
+            # operator tails while the soak runs
+            "interim": {"writes": counts.writes, "reads": counts.reads,
+                        "errors": counts.errors,
+                        "sheds": counts.sheds,
+                        "burn_minutes_total":
+                            round(sum(burn_minutes.values()), 4)},
+        }
+        path = os.path.join(run_root, "checkpoint.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf8") as f:
+            f.write(json.dumps(state) + "\n")
+        os.replace(tmp, path)
+
+    def _peek_incidents(i: int) -> List[dict]:
+        """Server i's current in-memory incident rows (burn-enriched),
+        without marking them harvested."""
+        obs = httpds[i].store.obs
+        rows = []
+        for r in obs.incidents.index_json()["incidents"]:
+            b = obs.incidents.get(r["id"]) or {}
+            ctx = b.get("context") or {}
+            rows.append({"id": r["id"], "t": r["t"], "kind": r["kind"],
+                         "series": r["series"],
+                         "detail": r.get("detail"),
+                         "server": addrs[i],
+                         "burn_minutes_total":
+                             ctx.get("burn_minutes_total", 0.0)})
+        return rows
 
     def publish(phase: str, tick: int, extra: str = "") -> None:
         worst, names = "ok", []
@@ -316,7 +536,11 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                            if names else "") + extra),
         })
 
-    for tick in range(ticks):
+    next_ckpt = 0.0
+    if checkpoint_every_s > 0:
+        next_ckpt = (start_tick * sc.tick_s) + checkpoint_every_s
+    for tick in range(start_tick, ticks):
+        tick_box["tick"] = tick + 1
         horizon = (tick + 1) * sc.tick_s
         while ev_i < len(events) and events[ev_i][0] < horizon:
             t, kind, arg = events[ev_i]
@@ -378,10 +602,39 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
                 if row["state"] != "ok":
                     burn_minutes[row["name"]] = burn_minutes.get(
                         row["name"], 0.0) + sc.tick_s / 60.0
+        # incident engine: one detector poll per live server per tick
+        # (the slo_transition events the evaluate() above just recorded
+        # are visible to this poll — burn bundles fire the same tick)
+        for j in range(sc.servers):
+            if live[j]:
+                httpds[j].store.obs.incident_detector.poll()
         publish("traffic", tick + 1)
         if progress:    # pragma: no cover - human pacing output
             print(f"  tick {tick + 1}/{ticks}: {counts.writes} writes "
                   f"{counts.reads} reads {counts.errors} errors")
+        virt = (tick + 1) * sc.tick_s
+        if checkpoint_every_s > 0 and virt >= next_ckpt:
+            _write_checkpoint(tick + 1)
+            while next_ckpt <= virt:
+                next_ckpt += checkpoint_every_s
+        if stop_after_ticks is not None and tick + 1 >= stop_after_ticks \
+                and tick + 1 < ticks:
+            # the in-process kill: force a checkpoint, then tear every
+            # server down crash-style (journals left open — resume
+            # replays the WALs, torn tails and all)
+            _write_checkpoint(tick + 1)
+            publish("aborted", tick + 1, extra=" aborted=True")
+            for i in range(sc.servers):
+                if not live[i]:
+                    continue
+                if nodes:
+                    nodes[i].journal = None
+                    nodes[i].leases.journal = None
+                httpds[i].shutdown()
+                httpds[i].server_close()
+            return {"aborted": True, "resume_dir": run_root,
+                    "tick": tick + 1, "ticks": ticks,
+                    "scenario": sc.name}
 
     # ---- bank-churn lane (device-tier spill accounting) ------------------
     bank_report = None
@@ -455,6 +708,27 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         for h in httpds])
     if qos_block is not None:
         qos_block["sheds_observed"] = counts.sheds
+    # incident engine: fold every surviving server's index into the
+    # run-level rows (crash victims were harvested at crash time, and
+    # a resumed run carries its pre-kill rows via the checkpoint)
+    for i in range(sc.servers):
+        _harvest_incidents(i)
+    by_kind = dict.fromkeys(INCIDENT_KINDS, 0)
+    for r in prior_incidents:
+        by_kind[r["kind"]] = by_kind.get(r["kind"], 0) + 1
+    worst = max(prior_incidents,
+                key=lambda r: r.get("burn_minutes_total", 0.0),
+                default=None)
+    incidents_block = {
+        "enabled": bool(incidents),
+        "count": len(prior_incidents),
+        "by_kind": by_kind,
+        "suppressed": prior_suppressed,
+        "worst_burn_minutes_id": worst["id"] if worst else None,
+        "worst_burn_minutes":
+            worst.get("burn_minutes_total", 0.0) if worst else 0.0,
+        "timeline": sorted(prior_incidents, key=lambda r: r["t"]),
+    }
     wall_s = time.monotonic() - t_start
     # under an injected-fault tape, availability degrades by DESIGN
     # (client errors while partitioned, SLO burn during the crash) —
@@ -486,19 +760,21 @@ def run_scenario(sc: Scenario, data_dir: Optional[str] = None,
         per_server=per_server,
         ok=ok,
         qos=qos_block,
+        incidents=incidents_block,
         extra={"session_churns": session_churns,
                **({"bank": bank_report} if bank_report else {}),
                **({"chaos": {**chaos_counts,
                              "faults": faults.snapshot()}}
-                  if sc.chaos else {})},
+                  if sc.chaos else {}),
+               **({"run_dir": run_root, "resumed": ck is not None}
+                  if keep_root else {})},
     )
     publish("done", ticks, extra=f" ok={ok}")
     for httpd in httpds:
         httpd.shutdown()
         httpd.server_close()
-    if chaos_root is not None:
-        import shutil
-        shutil.rmtree(chaos_root, ignore_errors=True)
+    if run_root is not None and not keep_root:
+        shutil.rmtree(run_root, ignore_errors=True)
     return card
 
 

@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: incident engine lock-order violation.
+
+Acquires the oplog guard (30) while already holding the detector's
+state guard (`_incident_lock`, leaf, 50) — backwards against the
+canonical order: the incident locks are innermost leaves; poll()
+gathers every TimeSeries/recorder read BEFORE taking the lock and
+opens bundles AFTER releasing it, so nothing may nest under them.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureDetector:
+    def backwards(self, series):
+        with self._incident_lock:
+            with self.store.lock:
+                return self._state[series]

@@ -1,0 +1,13 @@
+"""Seeded dt-lint fixture: incident kind schema drift.
+
+Opens an incident whose kind literal is not declared in
+obs.incident.INCIDENT_KINDS — the dt_incident_opened_total{kind}
+prom family zero-fills only the declared tuple, and the store would
+reject the kind at runtime anyway.
+Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureWatcher:
+    def alarm(self, series):
+        self.store.open_incident("rate_stalled", series, {"silent_s": 31.0})

@@ -58,6 +58,7 @@ def _witness_clean():
     ("bad_writergroup_lock_order.py", "lock-order", 15, "error"),
     ("bad_qos_lock_order.py", "lock-order", 17, "error"),
     ("bad_ts_lock_order.py", "lock-order", 15, "error"),
+    ("bad_incident_lock_order.py", "lock-order", 15, "error"),
     ("bad_wire_lock_order.py", "lock-order", 14, "error"),
     ("bad_xform_lock_order.py", "lock-order", 15, "error"),
     ("bad_unsorted_locks.py", "unsorted-locks", 15, "error"),
@@ -69,6 +70,7 @@ def _witness_clean():
     ("bad_unguarded_acquire.py", "unguarded-acquire", 12, "error"),
     ("bad_metrics_drift.py", "metrics-schema-drift", 11, "error"),
     ("bad_qos_metrics_drift.py", "metrics-schema-drift", 12, "error"),
+    ("bad_incident_metrics_drift.py", "metrics-schema-drift", 13, "error"),
     ("bad_exemplar_drift.py", "metrics-schema-drift", 9, "error"),
     ("bad_stale_suppression.py", "stale-suppression", 11, "warn"),
     # the two historical bugs PR 7's tree repairs fixed, re-expressed

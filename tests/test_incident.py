@@ -1,0 +1,365 @@
+"""Incident engine tests (obs/incident.py + the serving/runner wiring):
+the fake-clock detector matrix (each kind fires exactly once under
+cooldown, quiet-from-birth series never alarm), the disabled-path
+zero-allocation pin, the evidence-bundle round-trip through a live
+server's /debug/incidents endpoints + dt_incident_* prom zero-fill,
+and the long-run harness's kill-and-resume contract: a checkpointed
+smoke run aborted mid-tape and resumed must converge to the same
+deterministic scorecard slice as an uninterrupted control run.
+Tier-1 safe: fake clocks, in-process servers on ephemeral ports.
+"""
+
+import json
+import os
+import shutil
+import threading
+import tracemalloc
+import urllib.error
+import urllib.request
+
+import pytest
+
+from diamond_types_tpu.obs import Observability
+from diamond_types_tpu.obs.incident import (INCIDENT_KINDS,
+                                            AnomalyDetector,
+                                            IncidentStore)
+from diamond_types_tpu.obs.recorder import FlightRecorder
+from diamond_types_tpu.obs.timeseries import TimeSeries
+
+pytestmark = pytest.mark.incident
+
+
+class _Clock:
+    """Injectable monotonic clock shared by ring + detector."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _detector(clk, ts=None, recorder=None, store=None, **kw):
+    opts = dict(cooldown_s=300.0, rate_window_s=10.0, stall_after_s=30.0,
+                warmup_polls=3, spike_factor=8.0, p99_factor=4.0,
+                min_rate=0.5, min_p99_s=0.001)
+    opts.update(kw)
+    ts = ts if ts is not None else TimeSeries(clock=clk)
+    return ts, AnomalyDetector(ts, recorder=recorder, store=store,
+                               clock=clk, **opts)
+
+
+# ---- detector matrix (fake clock) ----------------------------------------
+
+def test_rate_stall_fires_exactly_once():
+    clk = _Clock()
+    ts, det = _detector(clk)
+    # warm the series past warmup_polls at a steady 1 op/s
+    for _ in range(4):
+        for _ in range(10):
+            ts.inc("serve.flush")
+        assert det.poll() == ()
+        clk.t += 10.0
+    # go silent past stall_after_s: exactly one rate_stall
+    clk.t += 35.0
+    fired = det.poll()
+    assert [(k, s) for k, s, _ in fired] == [("rate_stall", "serve.flush")]
+    assert fired[0][2]["silent_s"] >= 30.0
+    # still silent: re-arm requires new flow, not just cooldown
+    clk.t += 400.0
+    assert det.poll() == ()
+    # flow again, then stall again OUTSIDE cooldown: fires anew
+    for _ in range(10):
+        ts.inc("serve.flush")
+    det.poll()
+    clk.t += 35.0
+    fired = det.poll()
+    assert [k for k, _, _ in fired] == ["rate_stall"]
+
+
+def test_rate_spike_fires_once_then_cooldown_suppresses():
+    clk = _Clock()
+    ts, det = _detector(clk)
+    for _ in range(4):
+        for _ in range(5):
+            ts.inc("serve.ops")          # steady 0.5 op/s
+        assert det.poll() == ()
+        clk.t += 10.0
+    for _ in range(100):                 # 10 op/s burst: > 8x EWMA
+        ts.inc("serve.ops")
+    fired = det.poll()
+    assert [(k, s) for k, s, _ in fired] == [("rate_spike", "serve.ops")]
+    assert fired[0][2]["rate"] > 8.0 * fired[0][2]["ewma"]
+    # a second burst inside the cooldown window is deduped, not refired
+    before = det.suppressed
+    for _ in range(200):
+        ts.inc("serve.ops")
+    assert det.poll() == ()
+    assert det.suppressed == before + 1
+
+
+def test_p99_step_fires_exactly_once():
+    clk = _Clock()
+    ts, det = _detector(clk)
+    for _ in range(4):
+        for _ in range(20):
+            ts.observe("serve.flush", 0.010)
+        assert det.poll() == ()
+        clk.t += 10.0
+    for _ in range(20):
+        ts.observe("serve.flush", 0.500)   # 50x the trailing p99
+    fired = det.poll()
+    kinds = [(k, s) for k, s, _ in fired]
+    assert ("p99_step", "serve.flush") in kinds
+    assert len([k for k, _ in kinds if k == "p99_step"]) == 1
+    # same elevated p99 next poll: inside cooldown, suppressed
+    for _ in range(20):
+        ts.observe("serve.flush", 0.500)
+    assert not any(k == "p99_step" for k, _, _ in det.poll())
+
+
+def test_slo_burn_follows_recorder_transitions():
+    clk = _Clock()
+    rec = FlightRecorder(capacity=64)
+    ts, det = _detector(clk, recorder=rec)
+    assert det.poll() == ()
+    rec.record("slo_transition", objective="flush_p99",
+               series="serve.flush", frm="ok", to="burning",
+               fast_burn=20.0, slow_burn=2.0)
+    fired = det.poll()
+    assert [(k, s) for k, s, _ in fired] == [("slo_burn", "flush_p99")]
+    assert fired[0][2]["fast_burn"] == 20.0
+    # recovery transitions never alarm; cursor advances past them
+    rec.record("slo_transition", objective="flush_p99",
+               series="serve.flush", frm="burning", to="ok")
+    assert det.poll() == ()
+    # re-burn inside the cooldown window: suppressed, not duplicated
+    before = det.suppressed
+    rec.record("slo_transition", objective="flush_p99",
+               series="serve.flush", frm="ok", to="burning")
+    assert det.poll() == ()
+    assert det.suppressed == before + 1
+
+
+def test_quiet_from_birth_never_alarms():
+    clk = _Clock()
+    ts, det = _detector(clk)
+    # a series that emits once and dies before warming up: no alarm,
+    # ever — the stall watch only arms on established flow
+    ts.inc("repl.handoff")
+    for _ in range(50):
+        assert det.poll() == ()
+        clk.t += 60.0
+    assert det.snapshot()["watched"] >= 1
+
+
+def test_detector_opens_bundles_through_store():
+    clk = _Clock()
+    store = IncidentStore(clock=clk)
+    rec = FlightRecorder(capacity=64)
+    ts, det = _detector(clk, recorder=rec, store=store)
+    rec.record("slo_transition", objective="visibility_p99",
+               series="serve.visibility", frm="ok", to="burning")
+    det.poll()
+    snap = store.snapshot()
+    assert snap["total"] == 1 and snap["open"] == 1
+    assert snap["by_kind"]["slo_burn"] == 1
+    assert store.get(snap["last_id"])["series"] == "visibility_p99"
+
+
+def test_undeclared_kind_rejected():
+    store = IncidentStore()
+    with pytest.raises(ValueError):
+        store.open_incident("rate_stalled", "x", {})
+    assert store.snapshot()["total"] == 0
+
+
+def test_store_ack_and_capacity_ring():
+    clk = _Clock()
+    store = IncidentStore(capacity=2, clock=clk)
+    ids = [store.open_incident("rate_spike", f"s{i}", {})["id"]
+           for i in range(3)]
+    snap = store.snapshot()
+    assert snap["total"] == 3            # seq survives eviction
+    assert store.get(ids[0]) is None     # evicted, ring capacity 2
+    assert store.ack(ids[2]) and not store.ack(ids[0])
+    assert store.snapshot()["open"] == 1
+    idx = store.index_json()
+    assert [r["id"] for r in idx["incidents"]] == [ids[2], ids[1]]
+    assert idx["incidents"][0]["acknowledged"]
+
+
+# ---- zero-allocation disabled path ---------------------------------------
+
+def test_disabled_detector_single_branch_zero_alloc():
+    """`enabled=False` poll() is ONE branch returning a module-level
+    empty tuple: tracemalloc must attribute zero allocations to
+    obs/incident.py across 200 polls (mirrors the telemetry pin)."""
+    import diamond_types_tpu.obs.incident as inc_mod
+    ts = TimeSeries()
+    for _ in range(50):
+        ts.inc("serve.ops")
+    det = AnomalyDetector(ts, enabled=False)
+
+    def _cycle():
+        for _ in range(200):
+            det.poll()
+
+    _cycle()    # warm interpreter artifacts before measuring
+    files = {inc_mod.__file__}
+    grew = []
+    tracemalloc.start()
+    for _attempt in range(3):
+        before = tracemalloc.take_snapshot()
+        _cycle()
+        after = tracemalloc.take_snapshot()
+        grew = [st for st in after.compare_to(before, "lineno")
+                if st.size_diff > 0
+                and st.traceback[0].filename in files
+                and st.traceback[0].lineno > 0]
+        if not grew:
+            break
+    tracemalloc.stop()
+    assert not grew, [str(g) for g in grew]
+    assert det.polls == 0
+
+
+# ---- bundle round-trip through a live server -----------------------------
+
+def _serve_one(tmp_path=None, **obs_opts):
+    from diamond_types_tpu.tools.server import serve
+    opts = {"sample_rate": 1.0}
+    opts.update(obs_opts)
+    httpd = serve(port=0, obs_opts=opts,
+                  data_dir=str(tmp_path) if tmp_path else None)
+    addr = f"127.0.0.1:{httpd.server_address[1]}"
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, addr
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=5) as r:
+        return r.read().decode("utf8")
+
+
+def test_bundle_round_trip_and_persistence(tmp_path):
+    httpd, addr = _serve_one(tmp_path)
+    try:
+        obs = httpd.store.obs
+        # traced traffic first: bundles freeze the last sampled trace
+        # ids, and those must resolve via /debug/trace/<id>
+        body = json.dumps({"agent": "a1", "version": [], "ops":
+                           [{"kind": "ins", "pos": 0,
+                             "text": "hello"}]}).encode()
+        req = urllib.request.Request(
+            f"http://{addr}/doc/d1/edit", data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).read()
+        deadline = 50
+        while not obs.tracer.index(limit=1) and deadline:
+            deadline -= 1          # root span ends after the response
+            threading.Event().wait(0.01)
+        obs.recorder.record("circuit_open", peer="peer-9")
+        bundle = obs.incidents.open_incident(
+            "rate_stall", "convergence_lag.peer-9", {"silent_s": 31.0})
+        idx = json.loads(_get(addr, "/debug/incidents"))
+        assert idx["total"] == 1 and idx["open"] == 1
+        assert idx["by_kind"]["rate_stall"] == 1
+        row = idx["incidents"][0]
+        assert row["id"] == bundle["id"]
+        got = json.loads(_get(addr, f"/debug/incidents/{bundle['id']}"))
+        assert got["kind"] == "rate_stall"
+        assert got["series"] == "convergence_lag.peer-9"
+        # the frozen recorder tail carries the fault's events
+        assert any(ev["kind"] == "circuit_open"
+                   for ev in got["recorder_tail"])
+        assert {r["name"] for r in got["slo"]} >= {"flush_p99"}
+        # the frozen trace ids resolve on the trace debug endpoint
+        assert got["traces"], "bundle captured no sampled trace ids"
+        trace = json.loads(_get(addr, f"/debug/trace/{got['traces'][0]}"))
+        assert trace.get("spans"), trace
+        # persisted JSON under the run data dir matches the bundle id
+        p = os.path.join(str(tmp_path), "incidents",
+                         f"{bundle['id']}.json")
+        with open(p, encoding="utf8") as f:
+            assert json.load(f)["id"] == bundle["id"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(addr, "/debug/incidents/inc-9999")
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_prom_families_zero_filled_when_idle():
+    httpd, addr = _serve_one()
+    try:
+        text = _get(addr, "/metrics?format=prom")
+        assert "dt_incident_detector_enabled 1" in text
+        for kind in INCIDENT_KINDS:
+            assert f'dt_incident_opened_total{{kind="{kind}"}} 0' \
+                in text
+        assert "dt_incident_suppressed_total 0" in text
+        assert "dt_incident_open 0" in text
+        doc = json.loads(_get(addr, "/metrics"))
+        blk = doc["obs"]["incidents"]
+        assert blk["total"] == 0 and blk["enabled"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_prom_counts_opened_incident():
+    httpd, addr = _serve_one()
+    try:
+        httpd.store.obs.incidents.open_incident("p99_step",
+                                                "serve.flush", {})
+        text = _get(addr, "/metrics?format=prom")
+        assert 'dt_incident_opened_total{kind="p99_step"} 1' in text
+        assert "dt_incident_open 1" in text
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---- kill-and-resume determinism -----------------------------------------
+
+def _slice(card):
+    """The deterministic scorecard slice: identical between a resumed
+    run and an uninterrupted control. Wall-clock metrics are excluded,
+    and so is `bytes_received` (and the bytes_per_op derived from it):
+    HTTP response bodies carry variable-width float fields, so it
+    jitters by a few bytes even between two uninterrupted runs."""
+    totals = {k: v for k, v in card["totals"].items()
+              if k != "bytes_received"}
+    return json.dumps({
+        "totals": totals,
+        "scenario": card["scenario"],
+        "incidents": card.get("incidents"),
+        "session_churns": card.get("extra", {}).get("session_churns"),
+        "converged": card.get("convergence", {}).get("converged"),
+    }, sort_keys=True)
+
+
+def test_kill_and_resume_byte_identical_scorecard():
+    from diamond_types_tpu.workload.runner import run_scenario
+    from diamond_types_tpu.workload.spec import get_scenario
+
+    control = run_scenario(get_scenario("smoke"))
+    assert control["ok"], control
+
+    part = run_scenario(get_scenario("smoke"), checkpoint_every_s=1.0,
+                        stop_after_ticks=3)
+    assert part.get("aborted") and part["tick"] == 3
+    run_dir = part["resume_dir"]
+    try:
+        assert os.path.exists(os.path.join(run_dir, "checkpoint.json"))
+        card = run_scenario(None, resume_dir=run_dir)
+        assert card["ok"] and card["extra"]["resumed"]
+        assert card["convergence"]["converged"]
+        # the incidents block survives the kill/resume boundary
+        assert card["incidents"]["by_kind"] == dict.fromkeys(
+            INCIDENT_KINDS, 0) or card["incidents"]["count"] >= 0
+        assert _slice(card) == _slice(control)
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
