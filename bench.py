@@ -884,7 +884,9 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
                       flush_docs: int = None,
                       max_sessions: int = None,
                       device_plan: bool = False,
-                      pallas: bool = False):
+                      pallas: bool = False,
+                      steer: bool = True,
+                      device_stage: bool = True):
     """Sharded multi-document merge scheduler (serve/): replays the
     synthetic trace across `docs` docs on `shards` CPU-simulated shards
     through the router + shape-bucketed admission queue + per-shard
@@ -923,6 +925,10 @@ def bench_serve_sched(shards: int = 4, docs: int = 8, txns: int = 10,
         cmd.append("--pallas")
     if mesh_window:
         cmd.append("--mesh-window")
+    if not steer:
+        cmd.append("--no-steer")
+    if not device_stage:
+        cmd.append("--no-device-stage")
     if fused:
         cmd.append("--warmup")
     if not telemetry:
@@ -1644,6 +1650,58 @@ def _main() -> None:
                     3)
         except Exception as e:  # pragma: no cover
             extra["serve_sched_xform_error"] = str(e)[:120]
+        # Shape-steering + device-resident staging A/B on a FLASH-
+        # CROWD trace (migrating hot doc => churning window shapes,
+        # the worst case for jit-cache hit rate). Three arms on the
+        # same mesh-window tape: steered+staged (the PR 20 path),
+        # steering off (every novel shape compiles), device staging
+        # off (legacy host-numpy staging, full stage bytes). The
+        # no-steer arm's scorecard is the control for the
+        # `scorecard-diff --gate` verdict — byte parity is asserted
+        # per-arm by serve-bench itself (parity_ok).
+        try:
+            from diamond_types_tpu.obs.scorecard import diff_scorecards
+            skw = dict(mode="flash", mesh_window=True, fused=True,
+                       txns=24, steady_rounds=16, timeout=600)
+            svs = bench_serve_sched(steer=True, device_stage=True,
+                                    **skw)
+            svn = bench_serve_sched(steer=False, device_stage=True,
+                                    **skw)
+            svh = bench_serve_sched(steer=True, device_stage=False,
+                                    **skw)
+            full["serve_sched_steer"] = svs
+            full["serve_sched_no_steer"] = svn
+            full["serve_sched_host_stage"] = svh
+            diff = diff_scorecards(svn["scorecard"], svs["scorecard"])
+            full["steer_ab_diff"] = diff
+            extra["serve_sched_steer"] = {
+                "gate_ok": diff["ok"],
+                "regressions": diff["regressions"],
+                "parity": svs["parity_ok"],
+                "no_steer_parity": svn["parity_ok"],
+                "host_stage_parity": svh["parity_ok"],
+                "steady_jit_hit_rate": svs.get("steady_jit_hit_rate"),
+                "no_steer_steady_jit_hit_rate":
+                    svn.get("steady_jit_hit_rate"),
+                "steer_compiles":
+                    (svs.get("steer") or {}).get("compiles"),
+                "no_steer_compiles":
+                    (svn.get("steer") or {}).get("compiles"),
+                "staged_bytes_per_window":
+                    svs.get("staged_bytes_per_window"),
+                "host_staged_bytes_per_window":
+                    svh.get("staged_bytes_per_window"),
+                "ops_per_sec": svs["ops_per_sec"],
+                "no_steer_ops_per_sec": svn["ops_per_sec"],
+            }
+            hb = svh.get("staged_bytes_per_window")
+            db = svs.get("staged_bytes_per_window")
+            if isinstance(hb, (int, float)) and hb > 0 \
+                    and isinstance(db, (int, float)):
+                extra["serve_sched_steer"]["staged_bytes_reduction"] = \
+                    round(1.0 - db / hb, 4)
+        except Exception as e:  # pragma: no cover
+            extra["serve_sched_steer_error"] = str(e)[:120]
     except Exception as e:  # pragma: no cover
         extra["serve_sched_error"] = str(e)[:120]
 

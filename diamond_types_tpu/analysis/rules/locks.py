@@ -148,6 +148,18 @@ def _classify(expr: ast.AST, class_name: str) -> Optional[str]:
     # "_jit_lock" leaf rule below
     if "_xform_jit_lock" in src:
         return "device"
+    # window-arena staging: the donated-buffer recycle table guard is
+    # a DEVICE-class lock (acquire/adopt bracket the mesh dispatch but
+    # run under the scheduler's per-class replay, outside the oplog
+    # guard; the dispatch itself never runs while it is held) — must
+    # classify BEFORE the generic "_jit_lock" leaf rule below
+    if "_arena_lock" in src:
+        return "device"
+    # shape steering: the warm-class table guard is a pure leaf —
+    # note_warm/snap are called strictly OUTSIDE the jit-cache leaf
+    # locks and never dispatch or call back out while held
+    if "_steer_lock" in src:
+        return "leaf"
     if "_first_touch_lock" in src or "_jit_lock" in src:
         return "leaf"
     # live-telemetry tier: the TimeSeries ring guard (`_ts_lock`, also

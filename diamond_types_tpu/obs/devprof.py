@@ -24,9 +24,11 @@ class DeviceProfiler:
         self._shard: Dict[int, dict] = {}
         self.transfers = 0
         self.transfer_bytes = 0
+        self._transfer_detail: Dict[tuple, list] = {}
         self._fused = {"device_calls": 0, "docs": 0,
                        "wall_s": 0.0, "device_s": 0.0}
         self._window = {"dispatches": 0, "docs": 0, "shards": 0,
+                        "staged_bytes": 0,
                         "wall_s": 0.0, "device_s": 0.0}
 
     def reset(self) -> None:
@@ -35,9 +37,11 @@ class DeviceProfiler:
             self._shard = {}
             self.transfers = 0
             self.transfer_bytes = 0
+            self._transfer_detail = {}
             self._fused = {"device_calls": 0, "docs": 0,
                            "wall_s": 0.0, "device_s": 0.0}
             self._window = {"dispatches": 0, "docs": 0, "shards": 0,
+                            "staged_bytes": 0,
                             "wall_s": 0.0, "device_s": 0.0}
 
     def note_jit(self, cache: str, hit: bool) -> None:
@@ -81,13 +85,17 @@ class DeviceProfiler:
             s["device_s"] += device_s
 
     def observe_window(self, wall_s: float, device_s: float,
-                       n_docs: int, n_shards: int) -> None:
+                       n_docs: int, n_shards: int,
+                       staged_bytes: int = 0) -> None:
         """One mesh flush-window dispatch: `n_docs` docs from
         `n_shards` shards replayed in a single shard_map program
-        (scheduler._flush_window). Kept SEPARATE from the per-shard
-        flush totals — a window is cross-shard by construction, so
-        attributing its wall time to any one shard would double-count
-        against the per_shard rows."""
+        (scheduler._flush_window). `staged_bytes` is the host->device
+        byte count the window's state staging actually paid (0 when
+        the arena fast path or the device-side gather kept rows
+        resident — the saving ISSUE 20's staging claim is about).
+        Kept SEPARATE from the per-shard flush totals — a window is
+        cross-shard by construction, so attributing its wall time to
+        any one shard would double-count against the per_shard rows."""
         if not self.enabled:
             return
         with self._lock:
@@ -95,15 +103,27 @@ class DeviceProfiler:
             w["dispatches"] += 1
             w["docs"] += int(n_docs)
             w["shards"] += int(n_shards)
+            w["staged_bytes"] += int(staged_bytes)
             w["wall_s"] += wall_s
             w["device_s"] += device_s
 
-    def note_transfer(self, nbytes: int) -> None:
+    def note_transfer(self, nbytes: int, rung: str = "",
+                      purpose: str = "") -> None:
+        """Count one host->device transfer. `rung` names the ladder
+        rung that paid it (session/fused/mesh/pallas), `purpose` what
+        moved: "stage" (resident doc state), "plan" (the window's op
+        arrays — always host-built), or "warmup" (ahead-of-time
+        compiles). Untagged calls keep the legacy totals working."""
         if not self.enabled:
             return
         with self._lock:
             self.transfers += 1
             self.transfer_bytes += int(nbytes)
+            if rung or purpose:
+                d = self._transfer_detail.setdefault(
+                    (rung or "other", purpose or "other"), [0, 0])
+                d[0] += 1
+                d[1] += int(nbytes)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -133,11 +153,17 @@ class DeviceProfiler:
                       if nw else 0.0,
                       "mean_shards": round(w["shards"] / nw, 4)
                       if nw else 0.0,
+                      "staged_bytes": w["staged_bytes"],
+                      "staged_bytes_per_window": round(
+                          w["staged_bytes"] / nw, 2) if nw else 0.0,
                       "wall_s": round(w["wall_s"], 6),
                       "device_sync_s": round(w["device_s"], 6),
                       "device_fraction": round(
                           w["device_s"] / w["wall_s"], 4)
                       if w["wall_s"] else 0.0}
+            detail = {f"{r}.{p}": {"transfers": v[0], "bytes": v[1]}
+                      for (r, p), v
+                      in sorted(self._transfer_detail.items())}
             return {"enabled": self.enabled,
                     "jit_cache": jit,
                     "flush_wall_s": round(wall, 6),
@@ -145,6 +171,7 @@ class DeviceProfiler:
                     "device_fraction": round(dev / wall, 4) if wall else 0.0,
                     "transfers": self.transfers,
                     "transfer_bytes": self.transfer_bytes,
+                    "transfer_detail": detail,
                     "fused": fused,
                     "mesh_window": window,
                     "per_shard": per_shard}
@@ -158,6 +185,6 @@ def note_jit_lookup(cache: str, hit: bool) -> None:
         PROFILER.note_jit(cache, hit)
 
 
-def note_transfer(nbytes: int) -> None:
+def note_transfer(nbytes: int, rung: str = "", purpose: str = "") -> None:
     if PROFILER.enabled:
-        PROFILER.note_transfer(nbytes)
+        PROFILER.note_transfer(nbytes, rung=rung, purpose=purpose)

@@ -224,6 +224,12 @@ def _render_serve(b: _Builder, serve: dict) -> None:
             if key in window:
                 b.add(f"dt_serve_window_{key}_total", "counter",
                       window[key])
+        # zero-filled (window.get default): the staging families exist
+        # from the first scrape even against a pre-v13 snapshot
+        b.add("dt_serve_window_transfer_bytes_total", "counter",
+              window.get("staged_bytes", 0))
+        b.add("dt_serve_window_staged_bytes_per_window", "gauge",
+              window.get("staged_bytes_per_window", 0.0))
         for key in ("device_calls_per_window", "mesh_occupancy"):
             if key in window:
                 b.add(f"dt_serve_window_{key}", "gauge", window[key])
@@ -388,10 +394,15 @@ def _render_obs(b: _Builder, obs: dict) -> None:
     jit.update(dp.get("jit_cache") or {})
     for cache, hm in sorted(jit.items()):
         lb = {"cache": cache}
-        b.add("dt_devprof_jit_hits_total", "counter",
-              hm.get("hits", 0), labels=lb)
-        b.add("dt_devprof_jit_misses_total", "counter",
-              hm.get("misses", 0), labels=lb)
+        hits = hm.get("hits", 0)
+        misses = hm.get("misses", 0)
+        b.add("dt_devprof_jit_hits_total", "counter", hits, labels=lb)
+        b.add("dt_devprof_jit_misses_total", "counter", misses,
+              labels=lb)
+        # zero-filled hit-rate gauge per cache (0.0 until a lookup)
+        b.add("dt_devprof_jit_hit_rate", "gauge",
+              round(hits / (hits + misses), 4) if hits + misses
+              else 0.0, labels=lb)
     if dp:
         b.add("dt_devprof_flush_wall_seconds_total", "counter",
               dp.get("flush_wall_s", 0.0))
@@ -399,6 +410,15 @@ def _render_obs(b: _Builder, obs: dict) -> None:
               dp.get("device_sync_s", 0.0))
         b.add("dt_devprof_transfer_bytes_total", "counter",
               dp.get("transfer_bytes", 0))
+        # per-(rung, purpose) transfer split — stage vs plan vs warmup
+        for key, row in sorted((dp.get("transfer_detail")
+                                or {}).items()):
+            rung, _, purpose = key.partition(".")
+            lb = {"rung": rung, "purpose": purpose}
+            b.add("dt_devprof_transfer_detail_total", "counter",
+                  row.get("transfers", 0), labels=lb)
+            b.add("dt_devprof_transfer_detail_bytes_total", "counter",
+                  row.get("bytes", 0), labels=lb)
     wit = obs.get("witness") or {}
     if wit:
         # one gauge per observed class edge (small, bounded by the

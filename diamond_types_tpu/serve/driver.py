@@ -115,6 +115,41 @@ def _concurrent_feeders(schedule, doc_ids: List[str], seed: int):
     return {d: make_feeder(i) for i, d in enumerate(doc_ids)}
 
 
+def _flash_feeders(doc_ids: List[str], rounds: int, seed: int):
+    """Flash-crowd tape: a migrating hot doc takes op BURSTS while the
+    cold tail trickles, so each window's max-op count — and with it
+    the pow2 `n` jit shape class — thrashes from round to round. This
+    is the shape-steering stress tape: unsteered, nearly every window
+    lands on a cold `(b, n)` class; steered, windows pad onto the
+    warmed classes and the jit caches stay hot."""
+    ndocs = len(doc_ids)
+
+    def make_feeder(doc_idx: int):
+        def feeder(ol: OpLog):
+            agent = ol.get_or_create_agent_id("flash")
+            rng = random.Random(seed * 104729 + doc_idx)
+            ln = 0
+            for r in range(rounds):
+                hot = (r // 2) % max(ndocs, 1)
+                if doc_idx == hot:
+                    burst = 6 + rng.randrange(10)
+                elif (doc_idx + r) % 7 == 0:
+                    burst = 3 + rng.randrange(4)
+                else:
+                    burst = 1 + rng.randrange(2)
+                n = 0
+                for _ in range(burst):
+                    pos = rng.randint(0, ln)
+                    s = "".join(rng.choice("abcdefgh ")
+                                for _ in range(rng.randint(1, 3)))
+                    ol.add_insert(agent, pos, s)
+                    ln += len(s)
+                    n += 1
+                yield n
+        return feeder
+    return {d: make_feeder(i) for i, d in enumerate(doc_ids)}
+
+
 def run_serve_bench(shards: int = 4, docs: int = 8,
                     txns: Optional[int] = None, engine: str = "device",
                     mode: str = "trace", corpus: Optional[str] = None,
@@ -130,7 +165,9 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                     telemetry: bool = True,
                     journey: bool = True,
                     device_plan: bool = False,
-                    pallas: bool = False) -> dict:
+                    pallas: bool = False,
+                    steer: bool = True,
+                    device_stage: bool = True) -> dict:
     """Replay the workload through a fresh scheduler; returns a JSON-able
     report with throughput, the metrics snapshot, the parity gate, and
     the device-profiler snapshot (wall vs. device time per flush, jit
@@ -144,7 +181,15 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     flush tails through the device transform (tpu/xform.py) instead of
     the host tracker walk — the report's `transform` block counts how
     many tails actually resolved on device — and `pallas=True` adds the
-    Pallas replay rung at the top of the flush ladder."""
+    Pallas replay rung at the top of the flush ladder.
+
+    `steer=False` / `device_stage=False` are the PR-20 A/B control
+    arms: no batch-shape steering (every window dispatches its raw
+    pow2 shape class) and host-numpy mesh staging (every resident byte
+    round-trips per window). `mode="flash"` replays the flash-crowd
+    tape whose per-window op counts thrash the jit shape classes — the
+    steering stress shape; with `steady_rounds` the report's
+    `steady_jit_hit_rate` measures the post-warm phase alone."""
     doc_ids = [f"doc{i:03d}" for i in range(docs)]
     ols: Dict[str, OpLog] = {}
     for d in doc_ids:
@@ -167,6 +212,10 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         schedule = _concurrent_schedule(n_rounds, 2, seed)
         feeders = {d: f(ols[d]) for d, f in
                    _concurrent_feeders(schedule, doc_ids, seed).items()}
+    elif mode == "flash":
+        n_rounds = txns or 24
+        feeders = {d: f(ols[d]) for d, f in
+                   _flash_feeders(doc_ids, n_rounds, seed).items()}
     else:
         raise ValueError(f"unknown mode {mode!r}")
 
@@ -174,6 +223,14 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     # warmup compiles land in the "fused" jit_cache rows
     PROFILER.reset()
     PROFILER.enabled = True
+    # steering + staging arms: process-global switches, fresh state per
+    # bench run so A/B subprocesses and in-process repeats start equal
+    from ..parallel.arena import DEVICE_STAGE, reset_arenas
+    from ..tpu.steer import STEER
+    STEER.reset(table=True)
+    STEER.enabled = steer
+    DEVICE_STAGE.enabled = device_stage
+    reset_arenas()
     # with flush workers on, worker threads READ oplogs (tail planning)
     # while this loop APPENDS to them — the oplog lock makes that safe,
     # exactly the way the sync server passes DocStore.lock
@@ -233,11 +290,15 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     # each round appends one more txn per doc and drains, so each
     # flush carries its whole bucket with fresh tails — the docs-per-
     # device-call occupancy the fused flush exists to raise.
+    jit_steady0 = PROFILER.snapshot()["jit_cache"]
     if steady_rounds:
         if mode == "trace":
             sdata = synth_trace(n_txns=steady_rounds, seed=seed + 1)
             sfeeders = {d: f(ols[d]) for d, f in
                         _trace_feeders(sdata, doc_ids).items()}
+        elif mode == "flash":
+            sfeeders = {d: f(ols[d]) for d, f in _flash_feeders(
+                doc_ids, steady_rounds, seed + 1).items()}
         else:
             ssched = _concurrent_schedule(steady_rounds, 2, seed + 1)
             sfeeders = {d: f(ols[d]) for d, f in _concurrent_feeders(
@@ -273,6 +334,28 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
     # budget should fail loudly, not average the burn away
     obs.slo.evaluate()
     slo_verdict = obs.slo.verdict()
+
+    # jit hit rates over the REPLAY caches (fused/mesh/pallas — the
+    # classes steering snaps); steady rate from the post-burst deltas,
+    # the ">= 90% steady-state hits" number ISSUE 20 gates on
+    devprof = PROFILER.snapshot()
+    _replay = ("fused", "mesh", "pallas")
+
+    def _rate(now, base):
+        hits = lookups = 0
+        for c in _replay:
+            h1 = now.get(c, {}).get("hits", 0)
+            m1 = now.get(c, {}).get("misses", 0)
+            h0 = base.get(c, {}).get("hits", 0) if base else 0
+            m0 = base.get(c, {}).get("misses", 0) if base else 0
+            hits += h1 - h0
+            lookups += (h1 + m1) - (h0 + m0)
+        return (round(hits / lookups, 4) if lookups else None), lookups
+
+    jit_hit_rate, _ = _rate(devprof["jit_cache"], None)
+    steady_jit_hit_rate, steady_lookups = _rate(devprof["jit_cache"],
+                                                jit_steady0)
+    staged_per_window = m["window"]["staged_bytes_per_window"]
     report = {
         "config": {"shards": shards, "docs": docs, "engine": engine,
                    "mode": mode, "corpus": corpus,
@@ -286,6 +369,7 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
                    "mesh_window": sched.mesh_window,
                    "device_plan": sched.device_plan,
                    "pallas": sched.pallas,
+                   "steer": steer, "device_stage": device_stage,
                    "telemetry": telemetry, "journey": journey},
         "total_ops": total_ops,
         "submit_retries": retries,
@@ -303,15 +387,47 @@ def run_serve_bench(shards: int = 4, docs: int = 8,
         # per due bucket)
         "device_calls_per_window":
             m["window"]["device_calls_per_window"],
+        # shape steering + device-resident staging (PR 20): replay-
+        # cache hit rates (overall and steady-phase), host->device
+        # staging per mesh window, and the steer policy's own counters
+        "jit_hit_rate": jit_hit_rate,
+        "steady_jit_hit_rate": steady_jit_hit_rate,
+        "steady_jit_lookups": steady_lookups,
+        "staged_bytes_per_window": staged_per_window,
+        "steer": STEER.snapshot(),
         # the transform rung's engagement: tails whose merge positions
         # resolved on device vs. the host tracker walk
         "transform": m["transform"],
         "metrics": m,
-        "devprof": PROFILER.snapshot(),
+        "devprof": devprof,
         "obs": {"trace": obs.tracer.stats(),
                 "ts_recorded": obs.ts.recorded,
                 "journey": obs.journey.snapshot()},
     }
+    # a banded scorecard so serve-bench A/B arms gate through the SAME
+    # engine as scenario runs (`diff_scorecards` / scorecard-diff)
+    from ..obs.scorecard import build_scorecard
+    steady_or_overall = steady_jit_hit_rate if steady_jit_hit_rate \
+        is not None else jit_hit_rate
+    report["scorecard"] = build_scorecard(
+        scenario={"name": f"serve-bench-{mode}", "seed": seed,
+                  "steer": steer, "device_stage": device_stage},
+        wall_s=wall, virtual_s=0.0,
+        totals={"ops": total_ops, "writes": total_ops, "reads": 0,
+                "errors": len(mismatches)},
+        latency_p99_s={"flush": m["latencies"]["flush"]["p99"]},
+        slo={"slo_ok": slo_verdict["slo_ok"],
+             "burning": slo_verdict["burning"],
+             "warning": slo_verdict["warning"]},
+        ok=bool(not mismatches and slo_verdict["slo_ok"]),
+        serve={
+            "jit_cache_hit_rate": steady_or_overall,
+            "staged_bytes_per_window": staged_per_window,
+            "device_calls_per_window":
+                m["window"]["device_calls_per_window"],
+            "steer_compiles": report["steer"]["compiles"],
+        },
+    )
     PROFILER.enabled = False
     if mismatches:
         # a parity failure report should be diagnosable standalone:

@@ -127,8 +127,12 @@ class ServeMetrics:
     # these; prom exports them as dt_serve_hydration_spill*_total);
     # v12 = wire-tier remote hydration (`remote_fills` /
     # `remote_fill_errors` in the hydration block — cold misses
-    # hydrated from a peer's compacted snapshot frame)
-    SCHEMA_VERSION = 12
+    # hydrated from a peer's compacted snapshot frame);
+    # v13 = shape-steered device-resident staging (`staged_bytes` /
+    # `staged_bytes_per_window` in the window block — host->device
+    # bytes the mesh windows' state staging paid; near-zero when the
+    # arena / device-side gather keeps rows resident)
+    SCHEMA_VERSION = 13
 
     def __init__(self, n_shards: int, flush_docs: int,
                  max_pending: int) -> None:
@@ -152,6 +156,7 @@ class ServeMetrics:
         self.window_docs = 0
         self.mesh_docs = 0           # docs replayed via the mesh prog
         self.mesh_padded_rows = 0    # super-batch rows incl. padding
+        self.window_staged_bytes = 0  # host->device staging paid
         self.window_shards_hist: Dict[int, int] = {}
         # device-transform planning accounting (scheduler-level: the
         # batched dispatch is shared across a bucket)
@@ -222,14 +227,16 @@ class ServeMetrics:
 
     def record_window(self, dispatches: int, n_docs: int,
                       n_shards: int, mesh_docs: int = 0,
-                      padded_rows: int = 0) -> None:
+                      padded_rows: int = 0,
+                      staged_bytes: int = 0) -> None:
         """One flush window: `dispatches` device programs (mesh path:
         the number of shard_map calls, 1 for a uniform-shape window) or
         per-shard worker handoffs (the PR-5 control, >= n_shards when
         several shards' buckets are due) covering `n_docs` docs across
         `n_shards` shards. `device_calls_per_window` in the snapshot is
         dispatches / windows-with-device-work — the N-to-1 dispatch
-        claim, directly."""
+        claim, directly. `staged_bytes` is the host->device staging
+        the window's mesh dispatches paid (v13)."""
         with self._lock:
             self.windows += 1
             if dispatches > 0:
@@ -238,6 +245,7 @@ class ServeMetrics:
             self.window_docs += n_docs
             self.mesh_docs += mesh_docs
             self.mesh_padded_rows += padded_rows
+            self.window_staged_bytes += staged_bytes
             self.window_shards_hist[n_shards] = \
                 self.window_shards_hist.get(n_shards, 0) + 1
 
@@ -367,6 +375,10 @@ class ServeMetrics:
                 "mesh_occupancy": round(
                     self.mesh_docs
                     / max(self.mesh_padded_rows, 1), 4),
+                "staged_bytes": self.window_staged_bytes,
+                "staged_bytes_per_window": round(
+                    self.window_staged_bytes
+                    / max(self.device_windows, 1), 2),
                 "shards_hist": {
                     str(k): v for k, v in
                     sorted(self.window_shards_hist.items())},

@@ -658,7 +658,7 @@ class MergeScheduler:
             dlocks = [lk for s in shards
                       if id(lk := self._device_locks[s]) not in seen
                       and not seen.add(id(lk))]
-            dispatches = mesh_docs = padded_rows = 0
+            dispatches = mesh_docs = padded_rows = staged_bytes = 0
             failed: List[List[str]] = [[] for _ in entries]
             replayed: List[set] = [set() for _ in entries]
             for (cap, mi), rows in sorted(classes.items()):
@@ -675,6 +675,7 @@ class MergeScheduler:
                             attrs={"docs": len(rows), "cap": cap,
                                    "max_ins": mi})
                     ok = None
+                    staged = 0
                     if self.pallas and len(dlocks) <= 1:
                         # top rung: the Pallas step-kernel replay.
                         # Single-device windows only — the Pallas
@@ -700,12 +701,13 @@ class MergeScheduler:
                                           f"{e}"[:120])
                     if ok is None:
                         try:
-                            ok, device_s, bp = mesh_fused_replay(
-                                mesh, sessions, plans)
+                            ok, device_s, bp, staged = \
+                                mesh_fused_replay(mesh, sessions, plans)
                             dispatches += 1
                             mesh_docs += len(rows)
                             padded_rows += bp
-                            dspan.end(padded_b=bp)
+                            staged_bytes += staged
+                            dspan.end(padded_b=bp, staged_bytes=staged)
                         except Exception as e:
                             # mesh rung failed: these rows drop to the
                             # per-shard fused rung; whatever that can't
@@ -722,7 +724,8 @@ class MergeScheduler:
                             dspan.end(outcome="fallback")
                 wall = time.perf_counter() - t_cls
                 PROFILER.observe_window(wall, device_s, len(rows),
-                                        len(shards))
+                                        len(shards),
+                                        staged_bytes=staged)
                 for good, (ei, _s, _sess, _plan, d) in zip(ok, rows):
                     if good:
                         replayed[ei].add(d)
@@ -755,7 +758,8 @@ class MergeScheduler:
         fspan.end(dur_s=round(dur, 6), dispatches=dispatches)
         self.metrics.record_window(dispatches, n_docs, len(shards),
                                    mesh_docs=mesh_docs,
-                                   padded_rows=padded_rows)
+                                   padded_rows=padded_rows,
+                                   staged_bytes=staged_bytes)
         # live telemetry (mirrors _flush_items): queue waits, a flush
         # exemplar off the window span, per-doc attribution
         now_m = time.monotonic()

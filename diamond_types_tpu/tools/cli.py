@@ -252,7 +252,8 @@ def cmd_serve_bench(args) -> int:
               warmup=args.warmup, steady_rounds=args.steady_rounds,
               mesh_window=args.mesh_window, telemetry=args.telemetry,
               journey=args.journey,
-              device_plan=args.device_plan, pallas=args.pallas)
+              device_plan=args.device_plan, pallas=args.pallas,
+              steer=args.steer, device_stage=args.device_stage)
     if args.dry_run:
         # CI smoke preset: host engine, tiny workload, no jax needed
         kw.update(shards=2, docs=4, txns=6, engine="host",
@@ -277,6 +278,13 @@ def cmd_serve_bench(args) -> int:
               f"@ {report['fused_occupancy']} docs/call, "
               f"{report['device_calls_per_window']} device calls/"
               f"window, "
+              f"jit hit rate "
+              f"{report.get('jit_hit_rate') if report.get('jit_hit_rate') is not None else 'n/a'}"
+              + (f" (steady {report['steady_jit_hit_rate']})"
+                 if report.get("steady_jit_hit_rate") is not None
+                 else "")
+              + f", staged {report.get('staged_bytes_per_window', 0)} "
+              f"B/window, "
               f"parity {'OK' if report['parity_ok'] else 'MISMATCH'}, "
               + ("slo OK" if report["slo_ok"] else
                  "slo BURNING " + ",".join(report["slo"]["burning"])))
@@ -1324,8 +1332,11 @@ def main(argv=None) -> int:
                    help="rounds to replay (default: whole corpus)")
     c.add_argument("--engine", choices=("device", "host"),
                    default="device")
-    c.add_argument("--mode", choices=("trace", "concurrent"),
-                   default="trace")
+    c.add_argument("--mode", choices=("trace", "concurrent", "flash"),
+                   default="trace",
+                   help="flash = flash-crowd tape whose per-window op "
+                   "bursts thrash the jit shape classes (the "
+                   "shape-steering A/B tape)")
     c.add_argument("--corpus", help="crdt-testdata JSON trace file "
                    "(default: synthetic trace)")
     c.add_argument("--flush-docs", type=int, default=4)
@@ -1361,6 +1372,20 @@ def main(argv=None) -> int:
                    help="Pallas step-kernel replay rung at the top of "
                    "the flush ladder (pallas -> mesh -> fused -> "
                    "per-doc -> host)")
+    c.add_argument("--steer",
+                   action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="batch-shape steering: snap each window's "
+                   "(b, n) onto the nearest warmed jit shape class "
+                   "(tpu/steer.py; --no-steer = raw pow2 classes, "
+                   "the PR-20 A/B control arm)")
+    c.add_argument("--device-stage",
+                   action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="device-resident mesh staging + donated-"
+                   "buffer window arenas (parallel/arena.py; "
+                   "--no-device-stage = host-numpy staging every "
+                   "window, the PR-20 A/B control arm)")
     c.add_argument("--warmup", action="store_true",
                    help="pre-compile the fused jit kernels before "
                    "feeding (keeps compiles off the flush path)")

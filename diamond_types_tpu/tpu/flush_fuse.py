@@ -103,11 +103,14 @@ def _fused_fn(b: int, n: int, mi: int, cap: int):
         fn = _fused_jit_cache.get(key)
         from ..obs.devprof import note_jit_lookup
         note_jit_lookup("fused", fn is not None)
-        if fn is not None:
-            return fn
-        fn = jax.jit(make_replay_body(mi), donate_argnums=(0, 1))
-        _fused_jit_cache[key] = fn
-        return fn
+        if fn is None:
+            fn = jax.jit(make_replay_body(mi), donate_argnums=(0, 1))
+            _fused_jit_cache[key] = fn
+    # hit or miss, the class is warm from here on — tell the steer
+    # table (outside the cache guard; note_warm takes its own leaf)
+    from .steer import STEER
+    STEER.note_warm("fused", mi, cap, b, n)
+    return fn
 
 
 _pallas_jit_cache = {}
@@ -159,12 +162,13 @@ def _pallas_fn(b: int, n: int, mi: int, cap: int):
         fn = _pallas_jit_cache.get(key)
         from ..obs.devprof import note_jit_lookup
         note_jit_lookup("pallas", fn is not None)
-        if fn is not None:
-            return fn
-        fn = jax.jit(make_pallas_replay_body(mi, interpret),
-                     donate_argnums=(0, 1))
-        _pallas_jit_cache[key] = fn
-        return fn
+        if fn is None:
+            fn = jax.jit(make_pallas_replay_body(mi, interpret),
+                         donate_argnums=(0, 1))
+            _pallas_jit_cache[key] = fn
+    from .steer import STEER
+    STEER.note_warm("pallas", mi, cap, b, n)
+    return fn
 
 
 def pallas_fused_replay(sessions: List["FusedDocSession"],
@@ -180,11 +184,14 @@ def pallas_fused_replay(sessions: List["FusedDocSession"],
     assert b == len(plans) and b >= 1
     cap = sessions[0].cap
     mi = sessions[0].max_ins
-    n = _pow2(max(max(p.n_ops for p in plans), 1))
-    bp = _pow2(b) if b > 1 else 1
+    from .steer import STEER
+    n0 = _pow2(max(max(p.n_ops for p in plans), 1))
+    bp0 = _pow2(b) if b > 1 else 1
+    bp, n = STEER.snap("pallas", bp0, n0, mi, cap)
     pos, dlen, ilen, chars = pack_plans(plans, n, mi, bp)
     from ..obs.devprof import note_transfer
-    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes)
+    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes,
+                  rung="pallas", purpose="plan")
     docs = jnp.stack([s.docs for s in sessions]
                      + [sessions[0].docs] * (bp - b))
     lens = jnp.stack([s.lens for s in sessions]
@@ -225,12 +232,17 @@ def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
     import jax
     import jax.numpy as jnp
 
-    # sessions materialize at _pow2(max(len * headroom, cap, 256)) —
-    # warm the floor class a fresh session actually lands on, not the
-    # raw configured cap (which may name a class no session ever uses)
-    cap = _pow2(max(int(cap), 256))
+    from .steer import cap_class, warmup_batches
+
+    # sessions materialize at steer.cap_class(len * headroom) — warm
+    # the floor class a fresh session actually lands on, not the raw
+    # configured cap (which may name a class no session ever uses).
+    # Both the floor and the batch enumeration come from tpu/steer.py,
+    # the SAME table the flush path's snap() consults, so warmup and
+    # steering can never disagree on what counts as a warm class.
+    cap = cap_class(cap)
     compiled = 0
-    batches = sorted({1} | {_pow2(k) for k in range(2, flush_docs + 1)})
+    batches = warmup_batches(flush_docs)
     for b in batches:
         for ncls in shape_classes:
             n = _pow2(ncls)
@@ -254,6 +266,7 @@ def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
         # emit for any b in that range (O(log) classes)
         bps = sorted({pad_batch_count(b, ndev)
                       for b in range(1, mesh_shards * flush_docs + 1)})
+        from ..obs.devprof import note_transfer
         for bp in bps:
             for ncls in shape_classes:
                 n = _pow2(ncls)
@@ -265,6 +278,9 @@ def warmup_fused_cache(flush_docs: int = 8, cap: int = DEFAULT_CAP,
                 z = jax.device_put(jnp.zeros((bp, n), jnp.int32), sh)
                 ch = jax.device_put(
                     jnp.zeros((bp, n, max_ins), jnp.int32), sh)
+                note_transfer(docs.nbytes + lens.nbytes + 3 * z.nbytes
+                              + ch.nbytes, rung="mesh",
+                              purpose="warmup")
                 _out, out_lens = fn(docs, lens, z, z, z, ch)
                 jax.block_until_ready(out_lens)
                 compiled += 1
@@ -347,7 +363,11 @@ class FusedDocSession:
         import jax.numpy as jnp
 
         text = self.oplog.checkout_tip().snapshot()
-        cap = _pow2(max(int(len(text) * self.headroom), min_cap, 256))
+        # capacity class via steer.cap_class — the SAME floor warmup
+        # enumerates, so every materialized session lands on a class
+        # the warm table knows about (the cap-floor agreement fix)
+        from .steer import cap_class
+        cap = cap_class(max(int(len(text) * self.headroom), min_cap))
         buf = np.zeros(cap, np.int32)
         if text:
             buf[:len(text)] = np.frombuffer(
@@ -359,8 +379,9 @@ class FusedDocSession:
         self.frontier = tuple(int(x) for x in self.oplog.version)
         self.synced_to = len(self.oplog)
         self.resyncs += 1
+        self._arena_tag = None     # full rebuild invalidates any slot
         from ..obs.devprof import note_transfer
-        note_transfer(buf.nbytes)
+        note_transfer(buf.nbytes, rung="session", purpose="stage")
 
     # ---- host-side planning ----------------------------------------------
 
@@ -423,7 +444,11 @@ class FusedDocSession:
                         len(ol))
 
     def commit(self, docs, lens, plan: TailPlan) -> None:
-        """Adopt one fused-replay result row + the plan's bookkeeping."""
+        """Adopt one fused-replay result row + the plan's bookkeeping.
+        Clears the window-arena tag: the session's state rows are no
+        longer the arena's rows (the mesh rung re-tags committed rows
+        right after `adopt_results`, see parallel/arena.py)."""
+        self._arena_tag = None
         self.docs = docs
         self.lens = lens
         self.doc_len = plan.new_len
@@ -541,11 +566,14 @@ def fused_replay(sessions: List[FusedDocSession],
     assert b == len(plans) and b >= 1
     cap = sessions[0].cap
     mi = sessions[0].max_ins
-    n = _pow2(max(max(p.n_ops for p in plans), 1))
-    bp = _pow2(b) if b > 1 else 1
+    from .steer import STEER
+    n0 = _pow2(max(max(p.n_ops for p in plans), 1))
+    bp0 = _pow2(b) if b > 1 else 1
+    bp, n = STEER.snap("fused", bp0, n0, mi, cap)
     pos, dlen, ilen, chars = pack_plans(plans, n, mi, bp)
     from ..obs.devprof import note_transfer
-    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes)
+    note_transfer(pos.nbytes + dlen.nbytes + ilen.nbytes + chars.nbytes,
+                  rung="fused", purpose="plan")
     docs = jnp.stack([s.docs for s in sessions]
                      + [sessions[0].docs] * (bp - b))
     lens = jnp.stack([s.lens for s in sessions]

@@ -1,0 +1,16 @@
+"""Seeded dt-lint fixture: shape-steer table lock-order violation.
+
+Acquires a per-device replay guard (device, 40) while already holding
+the warm-class table guard (`_steer_lock`, leaf, 50) — backwards
+against the canonical order: `snap`/`note_warm` are pure table reads
+called strictly OUTSIDE the jit-cache and device locks by design, so
+steering code never reaches back down to a device rung while the
+table guard is held. Never imported; parsed by the lint engine only.
+"""
+
+
+class FixtureSteerPolicy:
+    def backwards(self, cache, key):
+        with self._steer_lock:
+            with self._device_locks[0]:
+                return self._table[cache].get(key)

@@ -61,6 +61,7 @@ def _witness_clean():
     ("bad_incident_lock_order.py", "lock-order", 15, "error"),
     ("bad_wire_lock_order.py", "lock-order", 14, "error"),
     ("bad_xform_lock_order.py", "lock-order", 15, "error"),
+    ("bad_steer_lock_order.py", "lock-order", 15, "error"),
     ("bad_unsorted_locks.py", "unsorted-locks", 15, "error"),
     ("bad_device_under_lock.py", "device-under-lock", 13, "error"),
     ("bad_unfenced_mutation.py", "unfenced-mutation", 15, "error"),

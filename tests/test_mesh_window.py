@@ -131,7 +131,7 @@ def test_mesh_fused_replay_randomized_parity():
                     b = o.get_or_create_agent_id("b")
                     o.add_insert_at(b, [], 0, "Z" * (i + 1))
         plans = [s.plan_tail() for s in sess]
-        ok, _dev, bp = pm.mesh_fused_replay(mesh, sess, plans)
+        ok, _dev, bp, _staged = pm.mesh_fused_replay(mesh, sess, plans)
         assert all(ok)
         assert bp % 4 == 0 and bp >= len(sess)
         ok_f, _ = ff.fused_replay(sess_f,

@@ -419,7 +419,7 @@ def test_metrics_endpoint_formats_and_debug_events():
             assert r.headers["Content-Type"].startswith(
                 "application/json")
             doc = json.loads(r.read())
-        assert doc["serve"]["version"] == 12
+        assert doc["serve"]["version"] == 13
         assert doc["serve"]["latencies"]["flush"]["count"] >= 1
         assert doc["obs"]["trace"]["started"] >= 1
         assert any(row["count"] >= 1

@@ -450,7 +450,7 @@ def test_two_server_smoke(tmp_path):
             # v3: histogram latencies + derived v2 keys
             assert "handoff" in m["replication"]["latencies"]
             assert m["replication"]["handoffs"]["latency_s_total"] >= 0
-            assert m["serve"]["version"] == 12
+            assert m["serve"]["version"] == 13
             assert m["serve"]["uptime_s"] >= 0
             assert "denied" in m["serve"]["totals"]
             assert "fenced" in m["serve"]["totals"]

@@ -343,7 +343,7 @@ def test_metrics_transform_block_and_version():
                        batches=1)
     m.bump(0, "pallas_fallbacks")
     s = m.snapshot()
-    assert s["version"] == 12
+    assert s["version"] == 13
     t = s["transform"]
     assert t["device_docs"] == 3 and t["host_docs"] == 1
     assert t["fallbacks"] == 1 and t["batches"] == 1
